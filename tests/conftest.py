@@ -1,0 +1,19 @@
+"""Test harness configuration.
+
+Unit tests run hermetically on CPU with 8 virtual XLA devices so the
+multi-device sharding paths compile and execute without TPU hardware
+(the driver dry-runs the multi-chip path the same way).  Benchmarks run
+separately on the real chip via bench.py.
+"""
+
+import os
+
+# Must be set before jax initialises its backends.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import throttlecrab_tpu  # noqa: E402,F401  (enables x64 before any tracing)

@@ -1,0 +1,59 @@
+"""Rate conversion tests, ported from the reference's `rate/tests.rs`."""
+
+from throttlecrab_tpu import Rate
+from throttlecrab_tpu.core.i64 import U64_MAX
+
+NS = 1_000_000_000
+
+
+class TestConstructors:
+    def test_per_second(self):
+        assert Rate.per_second(10).period() == 100_000_000  # 100ms
+        assert Rate.per_second(1).period() == NS
+        assert Rate.per_second(1000).period() == 1_000_000
+
+    def test_per_minute(self):
+        assert Rate.per_minute(60).period() == NS  # 1/s
+        assert Rate.per_minute(1).period() == 60 * NS
+
+    def test_per_hour(self):
+        assert Rate.per_hour(3600).period() == NS
+        assert Rate.per_hour(1).period() == 3600 * NS
+
+    def test_per_day(self):
+        assert Rate.per_day(86400).period() == NS
+        assert Rate.per_day(1).period() == 86400 * NS
+
+    def test_new_custom(self):
+        assert Rate.new(2_500_000_000).period() == 2_500_000_000
+
+
+class TestFromCountAndPeriod:
+    def test_simple(self):
+        # 100 requests per 60s = 0.6s per token
+        assert Rate.from_count_and_period(100, 60).period() == 600_000_000
+
+    def test_one_per_second(self):
+        assert Rate.from_count_and_period(60, 60).period() == NS
+
+    def test_fractional(self):
+        # 7 per 60s: 60e9/7 = 8571428571.43 -> truncated
+        assert Rate.from_count_and_period(7, 60).period() == 8571428571
+
+    def test_invalid_count_blocks_all(self):
+        r = Rate.from_count_and_period(0, 60)
+        assert r.period() == U64_MAX * NS
+        r = Rate.from_count_and_period(-5, 60)
+        assert r.period() == U64_MAX * NS
+
+    def test_invalid_period_blocks_all(self):
+        r = Rate.from_count_and_period(10, 0)
+        assert r.period() == U64_MAX * NS
+        r = Rate.from_count_and_period(10, -1)
+        assert r.period() == U64_MAX * NS
+
+    def test_f64_truncation_matches_reference(self):
+        # The reference computes (period * 1e9) / count in f64 then
+        # truncates (rate/mod.rs:172).  Spot-check a case where exact
+        # integer division would differ in the last digit.
+        assert Rate.from_count_and_period(3, 1).period() == int(1e9 / 3.0)

@@ -1,0 +1,227 @@
+"""Shared store test suite, ported from `store/store_test_suite.rs`.
+
+Every case runs against all three stores through the common Store protocol,
+like the reference's `test_all_stores!` macro (`store_test_suite.rs:11-18`).
+"""
+
+import pytest
+
+from throttlecrab_tpu import (
+    AdaptiveStore,
+    PeriodicStore,
+    ProbabilisticStore,
+    RateLimiter,
+)
+from throttlecrab_tpu.core.i64 import I64_MAX, I64_MIN
+
+NS = 1_000_000_000
+# Pure virtual time: stores seed their cleanup schedule lazily from the
+# first operation's now_ns, so any base works.
+BASE = 1_753_700_000 * NS
+TTL = 60 * NS
+
+
+@pytest.fixture(params=[PeriodicStore, AdaptiveStore, ProbabilisticStore])
+def store(request):
+    return request.param()
+
+
+class TestBasicOps:
+    def test_get_missing(self, store):
+        assert store.get("missing", BASE) is None
+
+    def test_set_and_get(self, store):
+        assert store.set_if_not_exists_with_ttl("k", 42, TTL, BASE)
+        assert store.get("k", BASE) == 42
+
+    def test_set_if_not_exists_refuses_existing(self, store):
+        assert store.set_if_not_exists_with_ttl("k", 1, TTL, BASE)
+        assert not store.set_if_not_exists_with_ttl("k", 2, TTL, BASE)
+        assert store.get("k", BASE) == 1
+
+
+class TestCompareAndSwap:
+    def test_cas_success(self, store):
+        store.set_if_not_exists_with_ttl("k", 10, TTL, BASE)
+        assert store.compare_and_swap_with_ttl("k", 10, 20, TTL, BASE)
+        assert store.get("k", BASE) == 20
+
+    def test_cas_wrong_old(self, store):
+        store.set_if_not_exists_with_ttl("k", 10, TTL, BASE)
+        assert not store.compare_and_swap_with_ttl("k", 99, 20, TTL, BASE)
+        assert store.get("k", BASE) == 10
+
+    def test_cas_missing_key(self, store):
+        assert not store.compare_and_swap_with_ttl("nope", 1, 2, TTL, BASE)
+
+    def test_cas_expired_key(self, store):
+        store.set_if_not_exists_with_ttl("k", 10, TTL, BASE)
+        later = BASE + TTL  # expiry == now → expired
+        assert not store.compare_and_swap_with_ttl("k", 10, 20, TTL, later)
+
+    def test_simulated_concurrent_cas(self, store):
+        # Two actors read the same value; only the first CAS wins
+        # (store_test_suite.rs:341-376).
+        store.set_if_not_exists_with_ttl("shared", 100, TTL, BASE)
+        seen = store.get("shared", BASE)
+        assert store.compare_and_swap_with_ttl("shared", seen, 200, TTL, BASE)
+        assert not store.compare_and_swap_with_ttl("shared", seen, 300, TTL, BASE)
+        assert store.get("shared", BASE) == 200
+
+
+class TestTTL:
+    def test_expiry(self, store):
+        store.set_if_not_exists_with_ttl("k", 7, TTL, BASE)
+        assert store.get("k", BASE + TTL - 1) == 7
+        assert store.get("k", BASE + TTL) is None  # expiry > now is strict
+        assert store.get("k", BASE + TTL + 1) is None
+
+    def test_one_ms_ttl(self, store):
+        ttl = NS // 1000
+        store.set_if_not_exists_with_ttl("k", 1, ttl, BASE)
+        assert store.get("k", BASE) == 1
+        assert store.get("k", BASE + ttl) is None
+
+    def test_zero_ttl(self, store):
+        store.set_if_not_exists_with_ttl("k", 1, 0, BASE)
+        assert store.get("k", BASE) is None  # expires immediately
+
+    def test_ttl_updated_on_cas(self, store):
+        store.set_if_not_exists_with_ttl("k", 1, TTL, BASE)
+        mid = BASE + TTL // 2
+        assert store.compare_and_swap_with_ttl("k", 1, 2, TTL, mid)
+        # Survives past the original expiry because CAS refreshed the TTL.
+        assert store.get("k", BASE + TTL + 1) == 2
+        assert store.get("k", mid + TTL) is None
+
+    def test_set_over_expired_key(self, store):
+        store.set_if_not_exists_with_ttl("k", 1, TTL, BASE)
+        later = BASE + TTL + 1
+        assert store.set_if_not_exists_with_ttl("k", 2, TTL, later)
+        assert store.get("k", later) == 2
+
+
+class TestValueRanges:
+    def test_negative_tat(self, store):
+        store.set_if_not_exists_with_ttl("k", -12345, TTL, BASE)
+        assert store.get("k", BASE) == -12345
+        assert store.compare_and_swap_with_ttl("k", -12345, -99999, TTL, BASE)
+        assert store.get("k", BASE) == -99999
+
+    def test_i64_extremes(self, store):
+        store.set_if_not_exists_with_ttl("max", I64_MAX, TTL, BASE)
+        store.set_if_not_exists_with_ttl("min", I64_MIN, TTL, BASE)
+        assert store.get("max", BASE) == I64_MAX
+        assert store.get("min", BASE) == I64_MIN
+        assert store.compare_and_swap_with_ttl("max", I64_MAX, I64_MIN, TTL, BASE)
+        assert store.get("max", BASE) == I64_MIN
+
+
+class TestKeyShapes:
+    def test_empty_key(self, store):
+        assert store.set_if_not_exists_with_ttl("", 1, TTL, BASE)
+        assert store.get("", BASE) == 1
+
+    def test_long_key(self, store):
+        key = "x" * 1000
+        assert store.set_if_not_exists_with_ttl(key, 1, TTL, BASE)
+        assert store.get(key, BASE) == 1
+
+    def test_unicode_key(self, store):
+        key = "пользователь:123:🔑"
+        assert store.set_if_not_exists_with_ttl(key, 1, TTL, BASE)
+        assert store.get(key, BASE) == 1
+
+
+class TestStress:
+    def test_500_keys(self, store):
+        for i in range(500):
+            assert store.set_if_not_exists_with_ttl(f"key_{i}", i, TTL, BASE)
+        for i in range(500):
+            assert store.get(f"key_{i}", BASE) == i
+        for i in range(500):
+            assert store.compare_and_swap_with_ttl(f"key_{i}", i, i * 2, TTL, BASE)
+        for i in range(500):
+            assert store.get(f"key_{i}", BASE) == i * 2
+
+
+class TestFullScenario:
+    def test_rate_limit_scenario(self, store):
+        # Full GCRA flow through each store (store_test_suite.rs:541-598).
+        limiter = RateLimiter(store)
+        for i in range(3):
+            allowed, result = limiter.rate_limit("user:1", 3, 30, 60, 1, BASE)
+            assert allowed, f"request {i + 1}"
+            assert result.remaining == 2 - i
+        allowed, result = limiter.rate_limit("user:1", 3, 30, 60, 1, BASE)
+        assert not allowed
+
+        # 30/60s = one token per 2s.
+        allowed, result = limiter.rate_limit("user:1", 3, 30, 60, 1, BASE + 2 * NS)
+        assert allowed
+        assert result.remaining == 0
+
+
+class TestCleanup:
+    def test_periodic_cleanup_removes_expired(self):
+        store = PeriodicStore.builder().cleanup_interval(10).build()
+        now = BASE
+        for i in range(10):
+            store.set_if_not_exists_with_ttl(f"k{i}", i, 5 * NS, now)
+        assert len(store) == 10
+        # Past the cleanup interval AND the TTLs: a mutating op sweeps.
+        later = now + 11 * NS
+        store.set_if_not_exists_with_ttl("fresh", 1, 60 * NS, later)
+        assert len(store) == 1  # only "fresh" survives
+        assert store.expired_count() == 10
+
+    def test_adaptive_cleanup_interval_adapts(self):
+        store = (
+            AdaptiveStore.builder()
+            .capacity(1000)
+            .min_interval(1)
+            .max_interval(300)
+            .build()
+        )
+        start_interval = store.current_interval_ns
+        now = BASE
+        # Nothing expired at sweep time → interval doubles.
+        store.set_if_not_exists_with_ttl("a", 1, 3600 * NS, now)
+        later = now + store.current_interval_ns + NS
+        store.set_if_not_exists_with_ttl("b", 2, 3600 * NS, later)
+        assert store.current_interval_ns == min(start_interval * 2, 300 * NS)
+
+    def test_adaptive_ops_count_trigger(self):
+        store = AdaptiveStore.builder().max_operations(100).build()
+        now = BASE
+        for i in range(50):
+            store.set_if_not_exists_with_ttl(f"k{i}", i, NS // 10, now)
+        # All entries' TTLs (0.1s) lapse; op-count trigger fires within the
+        # next 100 ops even though the time trigger is far away.
+        later = now + NS
+        for i in range(100):
+            store.set_if_not_exists_with_ttl(f"fresh{i}", i, 3600 * NS, later)
+        assert all(store.get(f"k{i}", later) is None for i in range(50))
+        assert len(store) <= 100
+
+    def test_adaptive_pressure_trigger_is_transient(self):
+        # With >3/4 of capacity live (non-expired), the pressure trigger
+        # must not degrade into a sweep per operation: the emulated
+        # allocation grows like the reference's Rust HashMap capacity.
+        store = AdaptiveStore.builder().capacity(100).build()
+        now = BASE
+        for i in range(5000):
+            store.set_if_not_exists_with_ttl(f"k{i}", i, 3600 * NS, now)
+        assert len(store) == 5000
+        assert store.capacity * 3 // 4 >= 5000  # pressure trigger disarmed
+
+    def test_probabilistic_cleanup_fires(self):
+        store = ProbabilisticStore.builder().cleanup_probability(10).build()
+        now = BASE
+        for i in range(20):
+            store.set_if_not_exists_with_ttl(f"k{i}", i, NS, now)
+        later = now + 2 * NS
+        # ~1 in 10 mutating ops sweeps; 100 ops guarantees several sweeps.
+        for i in range(100):
+            store.set_if_not_exists_with_ttl(f"fresh{i}", i, 3600 * NS, later)
+        assert len(store) == 100  # the 20 expired entries were swept

@@ -1,0 +1,69 @@
+"""throttlecrab-tpu: a TPU-native GCRA rate-limiting framework.
+
+A ground-up re-design of the capabilities of `lazureykis/throttlecrab`
+(reference: /root/reference) for TPU hardware:
+
+- **core**: scalar GCRA engine + in-memory stores with the exact semantics of
+  the reference library (`throttlecrab/src/core/rate_limiter.rs:102-250`).
+  Pure Python, used as the correctness oracle and CPU fallback.
+- **tpu**: the TPU execution backend — a Structure-of-Arrays bucket table in
+  HBM and a batched, jitted GCRA decision kernel (vmap'd over request
+  tensors), with cleanup-as-compaction sweeps.
+- **parallel**: multi-device sharding of the bucket table over a
+  `jax.sharding.Mesh` with psum-reduced metrics.
+- **server**: micro-batching front-end plus HTTP/JSON, Redis/RESP and gRPC
+  transports mirroring the reference server's wire formats
+  (`throttlecrab-server/src/transport/`).
+
+Time is always an explicit input (integer nanoseconds since the Unix epoch),
+never ambient state — the reference's key testability property
+(`rate_limiter.rs:109`).
+"""
+
+from __future__ import annotations
+
+import os
+
+# The GCRA state (theoretical-arrival-time) is i64 nanoseconds since epoch;
+# the device kernels need real int64, which JAX disables by default.  The
+# framework owns the process (it is a server), so enable x64 before any JAX
+# computation is traced.  Opt out with THROTTLECRAB_TPU_NO_X64=1.
+if not os.environ.get("THROTTLECRAB_TPU_NO_X64"):
+    try:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    except ImportError:  # pragma: no cover - jax is a hard dep in practice
+        pass
+
+from .core.errors import (  # noqa: E402
+    CellError,
+    InternalError,
+    InvalidRateLimit,
+    NegativeQuantity,
+)
+from .core.rate import Rate  # noqa: E402
+from .core.rate_limiter import RateLimiter, RateLimitResult  # noqa: E402
+from .core.store import (  # noqa: E402
+    AdaptiveStore,
+    PeriodicStore,
+    ProbabilisticStore,
+    Store,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AdaptiveStore",
+    "CellError",
+    "InternalError",
+    "InvalidRateLimit",
+    "NegativeQuantity",
+    "PeriodicStore",
+    "ProbabilisticStore",
+    "Rate",
+    "RateLimiter",
+    "RateLimitResult",
+    "Store",
+    "__version__",
+]
