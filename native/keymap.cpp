@@ -1,0 +1,227 @@
+// Host-side key→slot table for the TPU rate limiter.
+//
+// The reference's native hot path is its Rust HashMap keyed by string
+// (throttlecrab/src/core/store/periodic.rs:39-47); in the TPU design the
+// device owns the GCRA state and the host's per-request work shrinks to
+// resolving string keys to dense slot indices.  At the 10M+ req/s target
+// that resolution must not become the new bottleneck (SURVEY.md §7.4 hard
+// part 2), hence this C++ open-addressing table with a batch API: one FFI
+// call resolves a whole batch and emits the duplicate-segment structure
+// (occurrence rank + last-occurrence flag) the device kernel needs — the
+// Python fallback (throttlecrab_tpu/tpu/keymap.py) does the same with dicts.
+//
+// Design:
+//   - open addressing, power-of-two bucket count, linear probing;
+//   - FNV-1a 64-bit hashing;
+//   - keys interned in an append-only arena (offset, len per entry);
+//   - slot free-list for sweep recycling;
+//   - per-batch segment tracking via a batch-stamp on each entry: no
+//     per-call allocation, O(1) per request.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t FNV_OFFSET = 1469598103934665603ULL;
+constexpr uint64_t FNV_PRIME = 1099511628211ULL;
+
+inline uint64_t fnv1a(const char* data, int64_t len) {
+    uint64_t h = FNV_OFFSET;
+    for (int64_t i = 0; i < len; i++) {
+        h ^= static_cast<unsigned char>(data[i]);
+        h *= FNV_PRIME;
+    }
+    return h;
+}
+
+struct Entry {
+    uint64_t hash = 0;
+    int64_t key_off = -1;   // -1: bucket empty
+    int32_t key_len = 0;
+    int32_t slot = -1;
+    // Per-batch segment tracking.
+    uint64_t batch_stamp = 0;
+    int32_t batch_count = 0;
+    int32_t batch_last_pos = -1;
+};
+
+struct KeyMap {
+    std::vector<Entry> buckets;       // size is a power of two
+    uint64_t mask = 0;
+    std::vector<char> arena;          // interned key bytes
+    std::vector<int32_t> free_slots;  // stack, low indices on top
+    std::vector<int64_t> slot_bucket; // slot -> bucket index (-1 free)
+    int64_t size = 0;                 // live keys
+    int64_t capacity = 0;             // max slots
+    uint64_t batch_stamp = 0;
+
+    explicit KeyMap(int64_t cap) { init(cap); }
+
+    void init(int64_t cap) {
+        capacity = cap;
+        uint64_t nbuckets = 16;
+        while (nbuckets < static_cast<uint64_t>(cap) * 2) nbuckets <<= 1;
+        buckets.assign(nbuckets, Entry{});
+        mask = nbuckets - 1;
+        free_slots.resize(cap);
+        for (int64_t i = 0; i < cap; i++)
+            free_slots[i] = static_cast<int32_t>(cap - 1 - i);
+        slot_bucket.assign(cap, -1);
+        arena.reserve(cap * 16);
+    }
+
+    void rehash(uint64_t nbuckets) {
+        std::vector<Entry> old = std::move(buckets);
+        buckets.assign(nbuckets, Entry{});
+        mask = nbuckets - 1;
+        for (const Entry& e : old) {
+            if (e.key_off < 0) continue;
+            uint64_t b = e.hash & mask;
+            while (buckets[b].key_off >= 0) b = (b + 1) & mask;
+            buckets[b] = e;
+            slot_bucket[e.slot] = static_cast<int64_t>(b);
+        }
+    }
+
+    void grow_slots(int64_t new_cap) {
+        if (new_cap <= capacity) return;
+        free_slots.reserve(new_cap);
+        for (int64_t i = new_cap - 1; i >= capacity; i--)
+            free_slots.push_back(static_cast<int32_t>(i));
+        slot_bucket.resize(new_cap, -1);
+        capacity = new_cap;
+        if (static_cast<uint64_t>(new_cap) * 2 > buckets.size())
+            rehash(buckets.size() * 2 >= static_cast<uint64_t>(new_cap) * 2
+                       ? buckets.size()
+                       : [&] {
+                             uint64_t n = buckets.size();
+                             while (n < static_cast<uint64_t>(new_cap) * 2) n <<= 1;
+                             return n;
+                         }());
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tk_create(int64_t capacity) { return new KeyMap(capacity); }
+
+void tk_destroy(void* h) { delete static_cast<KeyMap*>(h); }
+
+int64_t tk_len(void* h) { return static_cast<KeyMap*>(h)->size; }
+
+int64_t tk_capacity(void* h) { return static_cast<KeyMap*>(h)->capacity; }
+
+void tk_grow(void* h, int64_t new_capacity) {
+    static_cast<KeyMap*>(h)->grow_slots(new_capacity);
+}
+
+// Resolve a batch of keys (concatenated bytes + offsets[n+1]) to slots,
+// allocating on miss.  valid[i] == 0 skips a request (slot -1).  Emits the
+// kernel's segment structure: rank (occurrence number within this batch) and
+// is_last (final occurrence within this batch).  Returns the number of
+// requests that could not be allocated because the table is full (their
+// slots are -1; caller grows and retries just those, passing them as the
+// only valid ones).
+int64_t tk_lookup_insert_batch(
+    void* h, const char* keys, const int64_t* offsets, int64_t n,
+    const uint8_t* valid, int32_t* out_slots, int32_t* out_rank,
+    uint8_t* out_is_last) {
+    KeyMap* m = static_cast<KeyMap*>(h);
+    m->batch_stamp++;
+    const uint64_t stamp = m->batch_stamp;
+    int64_t full = 0;
+    for (int64_t i = 0; i < n; i++) {
+        out_rank[i] = 0;
+        out_is_last[i] = 1;
+        if (!valid[i]) {
+            out_slots[i] = -1;
+            continue;
+        }
+        const char* key = keys + offsets[i];
+        const int64_t len = offsets[i + 1] - offsets[i];
+        const uint64_t hash = fnv1a(key, len);
+        uint64_t b = hash & m->mask;
+        Entry* e;
+        for (;;) {
+            e = &m->buckets[b];
+            if (e->key_off < 0) break;  // miss
+            if (e->hash == hash && e->key_len == len &&
+                memcmp(m->arena.data() + e->key_off, key, len) == 0)
+                break;  // hit
+            b = (b + 1) & m->mask;
+        }
+        if (e->key_off < 0) {
+            if (m->free_slots.empty()) {
+                out_slots[i] = -1;
+                full++;
+                continue;
+            }
+            const int32_t slot = m->free_slots.back();
+            m->free_slots.pop_back();
+            e->hash = hash;
+            e->key_off = static_cast<int64_t>(m->arena.size());
+            e->key_len = static_cast<int32_t>(len);
+            e->slot = slot;
+            m->arena.insert(m->arena.end(), key, key + len);
+            m->slot_bucket[slot] = static_cast<int64_t>(b);
+            m->size++;
+        }
+        out_slots[i] = e->slot;
+        if (e->batch_stamp == stamp) {
+            out_rank[i] = ++e->batch_count - 1;
+            out_is_last[e->batch_last_pos] = 0;
+            e->batch_last_pos = static_cast<int32_t>(i);
+        } else {
+            e->batch_stamp = stamp;
+            e->batch_count = 1;
+            e->batch_last_pos = static_cast<int32_t>(i);
+        }
+    }
+    return full;
+}
+
+// Free the given slots (from a sweep's expired mask).  Tombstone-free
+// removal for linear probing: re-place any displaced cluster members.
+int64_t tk_free_slots(void* h, const int32_t* slots, int64_t n) {
+    KeyMap* m = static_cast<KeyMap*>(h);
+    int64_t freed = 0;
+    for (int64_t i = 0; i < n; i++) {
+        const int32_t slot = slots[i];
+        if (slot < 0 || slot >= m->capacity) continue;
+        int64_t b = m->slot_bucket[slot];
+        if (b < 0) continue;  // not allocated
+        // Backward-shift deletion keeps probe chains intact.
+        uint64_t hole = static_cast<uint64_t>(b);
+        m->buckets[hole] = Entry{};
+        uint64_t j = (hole + 1) & m->mask;
+        while (m->buckets[j].key_off >= 0) {
+            const uint64_t home = m->buckets[j].hash & m->mask;
+            // Can entry at j move into the hole without breaking its probe
+            // sequence?  (standard backward-shift condition)
+            const bool movable =
+                ((j - home) & m->mask) >= ((j - hole) & m->mask);
+            if (movable) {
+                m->buckets[hole] = m->buckets[j];
+                m->slot_bucket[m->buckets[hole].slot] =
+                    static_cast<int64_t>(hole);
+                m->buckets[j] = Entry{};
+                hole = j;
+            }
+            j = (j + 1) & m->mask;
+        }
+        m->slot_bucket[slot] = -1;
+        m->free_slots.push_back(slot);
+        m->size--;
+        freed++;
+    }
+    return freed;
+}
+
+}  // extern "C"

@@ -1,0 +1,94 @@
+"""Cleanup policy triggers and adaptation (tpu/cleanup.py)."""
+
+from throttlecrab_tpu.tpu.cleanup import (
+    AdaptivePolicy,
+    PeriodicPolicy,
+    ProbabilisticPolicy,
+    make_policy,
+)
+
+NS = 1_000_000_000
+BASE = 1_753_700_000 * NS
+
+
+class TestPeriodic:
+    def test_fires_on_interval(self):
+        p = PeriodicPolicy(interval_ns=10 * NS)
+        assert not p.should_clean(BASE, 0, 1000)  # seeds
+        assert not p.should_clean(BASE + 9 * NS, 0, 1000)
+        assert p.should_clean(BASE + 10 * NS, 0, 1000)
+        p.after_sweep(BASE + 10 * NS, 5, 10)
+        assert not p.should_clean(BASE + 19 * NS, 0, 1000)
+        assert p.should_clean(BASE + 20 * NS, 0, 1000)
+
+
+class TestProbabilistic:
+    def test_fires_per_op_rule_over_ranges(self):
+        # probability 10, prime ≡ 1 (mod 10) → fires when ops crosses a
+        # multiple of 10.
+        p = ProbabilisticPolicy(probability=10)
+        p.record_ops(9)
+        assert not p.should_clean(BASE, 0, 1000)
+        p.record_ops(1)  # ops = 10
+        assert p.should_clean(BASE, 0, 1000)
+        p.after_sweep(BASE, 0, 0)
+        assert not p.should_clean(BASE, 0, 1000)
+        p.record_ops(25)  # crosses 20 and 30
+        assert p.should_clean(BASE, 0, 1000)
+
+    def test_batch_crossing(self):
+        p = ProbabilisticPolicy(probability=1000)
+        p.record_ops(999)
+        assert not p.should_clean(BASE, 0, 1000)
+        p.record_ops(4096)  # crosses 1000
+        assert p.should_clean(BASE, 0, 1000)
+
+
+class TestAdaptive:
+    def test_time_trigger_and_doubling(self):
+        p = AdaptivePolicy()
+        start = p.current_interval_ns
+        assert not p.should_clean(BASE, 0, 1 << 20)  # seeds
+        t = BASE + start
+        assert p.should_clean(t, 0, 1 << 20)
+        p.after_sweep(t, 0, 100)  # nothing removed → interval doubles
+        assert p.current_interval_ns == start * 2
+
+    def test_halving_on_productive_sweep(self):
+        p = AdaptivePolicy()
+        p.should_clean(BASE, 0, 1 << 20)
+        start = p.current_interval_ns
+        p.after_sweep(BASE, 80, 100)  # >50% removed → halves
+        assert p.current_interval_ns == max(start // 2, p.min_interval_ns)
+
+    def test_ops_trigger(self):
+        p = AdaptivePolicy(max_operations=5000)
+        p.should_clean(BASE, 0, 1 << 20)
+        p.record_ops(4999)
+        assert not p.should_clean(BASE + 1, 0, 1 << 20)
+        p.record_ops(1)
+        assert p.should_clean(BASE + 1, 0, 1 << 20)
+
+    def test_pressure_trigger(self):
+        p = AdaptivePolicy()
+        p.should_clean(BASE, 0, 1000)
+        assert not p.should_clean(BASE + 1, 750, 1000)
+        assert p.should_clean(BASE + 1, 751, 1000)
+
+    def test_interval_clamped(self):
+        p = AdaptivePolicy(min_interval_ns=NS, max_interval_ns=8 * NS)
+        p.should_clean(BASE, 0, 1 << 20)
+        for _ in range(10):
+            p.after_sweep(BASE, 0, 0)
+        assert p.current_interval_ns == 8 * NS
+        for _ in range(10):
+            p.after_sweep(BASE, 10, 10)
+        assert p.current_interval_ns == NS
+
+
+def test_factory():
+    assert isinstance(make_policy("periodic"), PeriodicPolicy)
+    assert isinstance(make_policy("adaptive"), AdaptivePolicy)
+    assert isinstance(make_policy("probabilistic"), ProbabilisticPolicy)
+    p = make_policy("periodic", cleanup_interval_secs=5)
+    assert p.interval_ns == 5 * NS

@@ -1,0 +1,110 @@
+"""C++ keymap vs the Python keymap: identical resolution + segment info."""
+
+import numpy as np
+import pytest
+
+from throttlecrab_tpu.native import NativeKeyMap, native_available
+from throttlecrab_tpu.tpu.limiter import segment_info
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native keymap toolchain unavailable"
+)
+
+
+def test_basic_resolution():
+    km = NativeKeyMap(64)
+    keys = [b"alpha", b"beta", b"alpha", b"gamma", b"beta", b"alpha"]
+    valid = np.ones(len(keys), bool)
+    slots, rank, is_last, n_full = km.resolve(keys, valid)
+    assert n_full == 0
+    assert len(km) == 3
+    # Same key → same slot; different keys → different slots.
+    assert slots[0] == slots[2] == slots[5]
+    assert slots[1] == slots[4]
+    assert len({slots[0], slots[1], slots[3]}) == 3
+    # Segment info: ranks count occurrences, is_last marks finals.
+    assert rank.tolist() == [0, 0, 1, 0, 1, 2]
+    assert is_last.tolist() == [False, False, False, True, True, True]
+
+
+def test_matches_python_segment_info():
+    rng = np.random.RandomState(3)
+    km = NativeKeyMap(128)
+    for trial in range(5):
+        n = int(rng.randint(1, 40))
+        keys = [f"k{rng.randint(10)}".encode() for _ in range(n)]
+        valid = rng.rand(n) > 0.2
+        slots, rank, is_last, _ = km.resolve(keys, valid)
+        rank2, is_last2 = segment_info(slots, valid)
+        np.testing.assert_array_equal(rank, rank2, err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(
+            is_last, is_last2, err_msg=f"trial {trial}"
+        )
+        assert (slots[~valid] == -1).all()
+
+
+def test_full_then_grow():
+    km = NativeKeyMap(4)
+    keys = [f"x{i}".encode() for i in range(8)]
+    valid = np.ones(8, bool)
+    slots, _, _, n_full = km.resolve(keys, valid)
+    assert n_full == 4
+    assert (slots >= 0).sum() == 4
+    km.grow(16)
+    missing = slots == -1
+    slots2, _, _, n_full2 = km.resolve(keys, missing)
+    assert n_full2 == 0
+    merged = np.where(missing, slots2, slots)
+    assert (merged >= 0).all()
+    assert len(set(merged.tolist())) == 8
+    assert len(km) == 8
+
+
+def test_free_and_recycle():
+    km = NativeKeyMap(16)
+    keys = [f"k{i}".encode() for i in range(10)]
+    valid = np.ones(10, bool)
+    slots, _, _, _ = km.resolve(keys, valid)
+    freed = km.free_slots(slots[:5])
+    assert freed == 5
+    assert len(km) == 5
+    # Freed keys are re-insertable; surviving keys keep their slots.
+    slots2, _, _, _ = km.resolve(keys, valid)
+    assert (slots2[5:] == slots[5:]).all()
+    assert len(km) == 10
+    # Double free is a no-op.
+    assert km.free_slots(slots[:5]) in range(0, 6)
+
+
+def test_unicode_and_long_keys():
+    km = NativeKeyMap(16)
+    keys = ["пользователь:🔑".encode(), b"x" * 1000, b""]
+    valid = np.ones(3, bool)
+    slots, rank, is_last, n_full = km.resolve(keys, valid)
+    assert n_full == 0
+    assert len(set(slots.tolist())) == 3
+    slots2, _, _, _ = km.resolve(keys, valid)
+    assert (slots == slots2).all()
+
+
+def test_churn_against_python_reference():
+    rng = np.random.RandomState(11)
+    km = NativeKeyMap(32)
+    pydict: dict = {}
+    for step in range(30):
+        n = int(rng.randint(1, 20))
+        keys = [f"c{rng.randint(30)}".encode() for _ in range(n)]
+        valid = np.ones(n, bool)
+        slots, _, _, n_full = km.resolve(keys, valid)
+        assert n_full == 0
+        for k, s in zip(keys, slots):
+            if k in pydict:
+                assert pydict[k] == s, f"slot moved for {k!r} at step {step}"
+            else:
+                pydict[k] = s
+        if step % 7 == 6:
+            drop = [k for i, k in enumerate(pydict) if i % 3 == 0]
+            km.free_slots(np.array([pydict[k] for k in drop], np.int32))
+            for k in drop:
+                del pydict[k]
+        assert len(km) == len(pydict)

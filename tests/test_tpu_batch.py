@@ -1,0 +1,360 @@
+"""Batched TPU kernel vs scalar oracle: exact-equivalence property tests.
+
+The scalar engine (core/rate_limiter.py, itself pinned against the reference
+semantics by test_gcra_math.py) processes each batch request-at-a-time in
+arrival order; the batched kernel must produce identical outputs AND
+identical table state — including intra-batch duplicate keys, degenerate
+corners (burst=1, quantity=0, sub-ns emission), mid-batch parameter changes,
+expiry, and sweeps.
+"""
+
+import numpy as np
+import pytest
+
+from throttlecrab_tpu import RateLimiter
+from throttlecrab_tpu.core.errors import CellError
+from throttlecrab_tpu.core.i64 import I64_MAX
+from throttlecrab_tpu.core.store.mapstore import MapStore
+from throttlecrab_tpu.tpu import (
+    EMPTY_EXPIRY,
+    STATUS_INVALID_PARAMS,
+    STATUS_NEGATIVE_QUANTITY,
+    STATUS_OK,
+    TpuRateLimiter,
+)
+
+NS = 1_000_000_000
+BASE = 1_753_700_000 * NS
+
+
+class OracleStore(MapStore):
+    """Dict store with cleanup disabled: pure CAS/TTL semantics."""
+
+    def _maybe_cleanup(self, now_ns):
+        pass
+
+
+def oracle_batch(limiter, keys, burst, count, period, qty, now_ns):
+    n = len(keys)
+    out = {
+        "allowed": np.zeros(n, bool),
+        "remaining": np.zeros(n, np.int64),
+        "reset": np.zeros(n, np.int64),
+        "retry": np.zeros(n, np.int64),
+        "status": np.zeros(n, np.uint8),
+    }
+    for i in range(n):
+        try:
+            a, r = limiter.rate_limit(
+                keys[i], int(burst[i]), int(count[i]), int(period[i]),
+                int(qty[i]), now_ns,
+            )
+        except CellError:
+            out["status"][i] = (
+                STATUS_NEGATIVE_QUANTITY if qty[i] < 0 else STATUS_INVALID_PARAMS
+            )
+            continue
+        out["allowed"][i] = a
+        out["remaining"][i] = r.remaining
+        out["reset"][i] = min(r.reset_after_ns, I64_MAX)
+        out["retry"][i] = min(r.retry_after_ns, I64_MAX)
+    return out
+
+
+def assert_batch_equal(tpu_res, oracle_res, context=""):
+    np.testing.assert_array_equal(
+        tpu_res.status, oracle_res["status"], err_msg=f"status {context}"
+    )
+    ok = oracle_res["status"] == STATUS_OK
+    np.testing.assert_array_equal(
+        tpu_res.allowed[ok], oracle_res["allowed"][ok], err_msg=f"allowed {context}"
+    )
+    np.testing.assert_array_equal(
+        tpu_res.remaining[ok], oracle_res["remaining"][ok],
+        err_msg=f"remaining {context}",
+    )
+    np.testing.assert_array_equal(
+        tpu_res.reset_after_ns[ok], oracle_res["reset"][ok],
+        err_msg=f"reset_after {context}",
+    )
+    np.testing.assert_array_equal(
+        tpu_res.retry_after_ns[ok], oracle_res["retry"][ok],
+        err_msg=f"retry_after {context}",
+    )
+
+
+def assert_state_equal(tpu: TpuRateLimiter, store: OracleStore, context=""):
+    tat = np.asarray(tpu.table.tat)
+    expiry = np.asarray(tpu.table.expiry)
+    for key, (tat_o, exp_o) in store._data.items():
+        slot = tpu.keymap._map.get(key)
+        assert slot is not None, f"{context}: oracle has {key!r}, keymap doesn't"
+        assert tat[slot] == tat_o, f"{context}: tat mismatch for {key!r}"
+        exp_clamped = min(exp_o, I64_MAX) if exp_o is not None else I64_MAX
+        assert expiry[slot] == exp_clamped, f"{context}: expiry mismatch for {key!r}"
+    # Keys the oracle never wrote must be vacant (or untouched) in the table.
+    for key, slot in tpu.keymap._map.items():
+        if key not in store._data:
+            assert expiry[slot] == EMPTY_EXPIRY, (
+                f"{context}: table has state for unwritten key {key!r}"
+            )
+
+
+@pytest.fixture
+def pair():
+    return TpuRateLimiter(capacity=256), RateLimiter(OracleStore())
+
+
+def run_and_compare(tpu, oracle, keys, burst, count, period, qty, now, ctx=""):
+    n = len(keys)
+    burst = np.broadcast_to(np.asarray(burst, np.int64), (n,))
+    count = np.broadcast_to(np.asarray(count, np.int64), (n,))
+    period = np.broadcast_to(np.asarray(period, np.int64), (n,))
+    qty = np.broadcast_to(np.asarray(qty, np.int64), (n,))
+    res = tpu.rate_limit_batch(keys, burst, count, period, qty, now)
+    exp = oracle_batch(oracle, keys, burst, count, period, qty, now)
+    assert_batch_equal(res, exp, ctx)
+    assert_state_equal(tpu, oracle.store, ctx)
+    return res
+
+
+class TestBasics:
+    def test_unique_keys_burst(self, pair):
+        tpu, oracle = pair
+        keys = [f"k{i}" for i in range(8)]
+        run_and_compare(tpu, oracle, keys, 5, 10, 60, 1, BASE, "batch0")
+
+    def test_sequential_batches_exhaust_burst(self, pair):
+        tpu, oracle = pair
+        for b in range(7):
+            run_and_compare(
+                tpu, oracle, ["user:1"], 5, 10, 60, 1, BASE, f"batch{b}"
+            )
+
+    def test_replenishment_across_batches(self, pair):
+        tpu, oracle = pair
+        run_and_compare(tpu, oracle, ["k"] * 5, 5, 10, 60, 1, BASE, "exhaust")
+        for dt in (1, 3, 6, 7, 12, 60):
+            run_and_compare(
+                tpu, oracle, ["k"], 5, 10, 60, 1, BASE + dt * NS, f"+{dt}s"
+            )
+
+
+class TestDuplicates:
+    def test_duplicate_key_serialized(self, pair):
+        tpu, oracle = pair
+        # 8 requests for one key, burst 5: exactly 5 allowed, in order.
+        res = run_and_compare(
+            tpu, oracle, ["hot"] * 8, 5, 10, 60, 1, BASE, "dup"
+        )
+        assert res.allowed.sum() == 5
+        assert res.allowed[:5].all() and not res.allowed[5:].any()
+
+    def test_duplicates_interleaved_with_others(self, pair):
+        tpu, oracle = pair
+        keys = ["a", "hot", "b", "hot", "c", "hot", "hot", "d", "hot"]
+        run_and_compare(tpu, oracle, keys, 3, 30, 60, 1, BASE, "interleaved")
+
+    def test_duplicate_quantities(self, pair):
+        tpu, oracle = pair
+        # Same key, same quantity per batch (uniformity holds), quantity 2.
+        run_and_compare(tpu, oracle, ["q"] * 6, 10, 100, 60, 2, BASE, "q2")
+
+    def test_param_change_mid_batch(self, pair):
+        tpu, oracle = pair
+        # Key 'x' appears with different params within one batch: the
+        # conflict-round path must preserve arrival-order semantics.
+        keys = ["x", "x", "x", "y", "x"]
+        burst = np.array([5, 5, 3, 4, 5], np.int64)
+        count = np.array([10, 10, 30, 40, 10], np.int64)
+        period = np.array([60, 60, 60, 60, 60], np.int64)
+        qty = np.array([1, 1, 1, 1, 1], np.int64)
+        res = tpu.rate_limit_batch(keys, burst, count, period, qty, BASE)
+        exp = oracle_batch(oracle, keys, burst, count, period, qty, BASE)
+        assert_batch_equal(res, exp, "param-change")
+        assert_state_equal(tpu, oracle.store, "param-change")
+
+
+class TestDegenerateCorners:
+    def test_burst_one_never_denies(self, pair):
+        tpu, oracle = pair
+        run_and_compare(tpu, oracle, ["b1"] * 6, 1, 1, 60, 1, BASE, "b1q1")
+        run_and_compare(tpu, oracle, ["b1"] * 3, 1, 1, 60, 1, BASE + 1, "b1q1+1ns")
+
+    def test_burst_one_quantity_two(self, pair):
+        tpu, oracle = pair
+        run_and_compare(tpu, oracle, ["b1"] * 4, 1, 60, 60, 2, BASE, "b1q2")
+
+    def test_burst_one_quantity_zero(self, pair):
+        tpu, oracle = pair
+        run_and_compare(tpu, oracle, ["b1"] * 4, 1, 60, 60, 0, BASE, "b1q0")
+
+    def test_quantity_zero_probe(self, pair):
+        tpu, oracle = pair
+        run_and_compare(tpu, oracle, ["p"] * 3, 5, 10, 60, 0, BASE, "q0-fresh")
+        run_and_compare(tpu, oracle, ["p"] * 2, 5, 10, 60, 1, BASE, "q1-after")
+        run_and_compare(tpu, oracle, ["p"] * 3, 5, 10, 60, 0, BASE, "q0-live")
+
+    def test_zero_emission_interval(self, pair):
+        tpu, oracle = pair
+        # count > period * 1e9 → emission interval 0 ns.
+        run_and_compare(
+            tpu, oracle, ["z"] * 4, 5, 2_000_000_000, 1, 1, BASE, "E0"
+        )
+
+    def test_stale_key_clamped(self, pair):
+        tpu, oracle = pair
+        run_and_compare(tpu, oracle, ["s"] * 3, 4, 60, 60, 1, BASE, "fill")
+        # Far in the future (but within TTL? no — past TTL it's a miss;
+        # use a long period so the entry survives) the TAT clamp applies.
+        run_and_compare(
+            tpu, oracle, ["s"] * 2, 4, 4, 3600, 1, BASE + 30 * NS, "clamped"
+        )
+
+
+class TestValidation:
+    def test_status_codes(self, pair):
+        tpu, oracle = pair
+        keys = ["ok", "neg", "bad", "ok2"]
+        burst = np.array([5, 5, 0, 5], np.int64)
+        count = np.array([10, 10, 10, 10], np.int64)
+        period = np.array([60, 60, 60, 60], np.int64)
+        qty = np.array([1, -1, 1, 1], np.int64)
+        res = tpu.rate_limit_batch(keys, burst, count, period, qty, BASE)
+        exp = oracle_batch(oracle, keys, burst, count, period, qty, BASE)
+        assert list(res.status) == [
+            STATUS_OK,
+            STATUS_NEGATIVE_QUANTITY,
+            STATUS_INVALID_PARAMS,
+            STATUS_OK,
+        ]
+        assert_batch_equal(res, exp, "validation")
+
+    def test_scalar_compat_api_raises(self, pair):
+        tpu, _ = pair
+        with pytest.raises(CellError):
+            tpu.rate_limit("k", 5, 10, 60, -1, BASE)
+        with pytest.raises(CellError):
+            tpu.rate_limit("k", 0, 10, 60, 1, BASE)
+        allowed, result = tpu.rate_limit("k", 5, 10, 60, 1, BASE)
+        assert allowed and result.remaining == 4 and result.limit == 5
+
+
+class TestTableLifecycle:
+    def test_growth(self):
+        tpu = TpuRateLimiter(capacity=16)
+        oracle = RateLimiter(OracleStore())
+        keys = [f"g{i}" for i in range(100)]
+        run_and_compare(tpu, oracle, keys, 5, 10, 60, 1, BASE, "grow")
+        assert tpu.table.capacity >= 100
+        assert len(tpu) == 100
+
+    def test_sweep_frees_and_recycles(self):
+        tpu = TpuRateLimiter(capacity=64)
+        keys = [f"e{i}" for i in range(32)]
+        # 10/60s → tolerance 4*6s=24s; TTL ≈ 30s.
+        tpu.rate_limit_batch(keys, [5] * 32, [10] * 32, [60] * 32, [1] * 32, BASE)
+        assert len(tpu) == 32
+        freed = tpu.sweep(BASE + 120 * NS)
+        assert freed == 32
+        assert len(tpu) == 0
+        # Recycled slots behave as fresh keys.
+        res = tpu.rate_limit_batch(
+            ["fresh"], [5], [10], [60], [1], BASE + 121 * NS
+        )
+        assert res.allowed[0] and res.remaining[0] == 4
+
+    def test_expired_key_is_miss_before_sweep(self, pair):
+        tpu, oracle = pair
+        run_and_compare(tpu, oracle, ["x"] * 5, 5, 10, 60, 1, BASE, "fill")
+        # Way past the TTL, no sweep has run: both see a fresh key.
+        run_and_compare(
+            tpu, oracle, ["x"], 5, 10, 60, 1, BASE + 3600 * NS, "post-ttl"
+        )
+
+
+class TestRandomizedEquivalence:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_scenarios(self, seed):
+        rng = np.random.RandomState(seed)
+        tpu = TpuRateLimiter(capacity=64)
+        oracle = RateLimiter(OracleStore())
+        pool = [f"key{i}" for i in range(12)]
+        # Per-key fixed params (heterogeneous across keys), including
+        # degenerate bursts and quantities.
+        params = {
+            k: (
+                int(rng.randint(1, 8)),        # burst (incl. 1)
+                int(rng.randint(1, 2000)),     # count
+                int(rng.choice([1, 10, 60, 3600])),
+            )
+            for k in pool
+        }
+        now = BASE
+        for step in range(12):
+            n = int(rng.randint(1, 24))
+            keys = [pool[rng.randint(len(pool))] for _ in range(n)]
+            burst = np.array([params[k][0] for k in keys], np.int64)
+            count = np.array([params[k][1] for k in keys], np.int64)
+            period = np.array([params[k][2] for k in keys], np.int64)
+            # One quantity per key per batch (uniformity), 0..3.
+            qty_by_key = {k: int(rng.randint(0, 4)) for k in set(keys)}
+            qty = np.array([qty_by_key[k] for k in keys], np.int64)
+            run_and_compare(
+                tpu, oracle, keys, burst, count, period, qty, now,
+                f"seed{seed}-step{step}",
+            )
+            now += int(rng.randint(0, 5 * NS))
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_scenarios_native_keymap(self, seed):
+        from throttlecrab_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("native keymap unavailable")
+        rng = np.random.RandomState(50 + seed)
+        tpu = TpuRateLimiter(capacity=64, keymap="native")
+        oracle = RateLimiter(OracleStore())
+        pool = [f"n{i}" for i in range(10)]
+        params = {
+            k: (int(rng.randint(1, 8)), int(rng.randint(1, 500)), 60)
+            for k in pool
+        }
+        now = BASE
+        for step in range(10):
+            n_req = int(rng.randint(1, 20))
+            keys = [pool[rng.randint(len(pool))] for _ in range(n_req)]
+            burst = np.array([params[k][0] for k in keys], np.int64)
+            count = np.array([params[k][1] for k in keys], np.int64)
+            period = np.array([params[k][2] for k in keys], np.int64)
+            qty_by_key = {k: int(rng.randint(0, 3)) for k in set(keys)}
+            qty = np.array([qty_by_key[k] for k in keys], np.int64)
+            res = tpu.rate_limit_batch(keys, burst, count, period, qty, now)
+            exp = oracle_batch(oracle, keys, burst, count, period, qty, now)
+            assert_batch_equal(res, exp, f"native{seed}-step{step}")
+            now += int(rng.randint(0, 5 * NS))
+        # Sweep path through the native free list.
+        freed = tpu.sweep(now + 7200 * NS)
+        assert freed == len(oracle.store._data) or freed <= 10
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_with_param_churn(self, seed):
+        # Params RE-randomized per request (same key may carry different
+        # params within one batch) → exercises the conflict-round path.
+        rng = np.random.RandomState(100 + seed)
+        tpu = TpuRateLimiter(capacity=64)
+        oracle = RateLimiter(OracleStore())
+        pool = [f"c{i}" for i in range(6)]
+        now = BASE
+        for step in range(8):
+            n = int(rng.randint(2, 16))
+            keys = [pool[rng.randint(len(pool))] for _ in range(n)]
+            burst = rng.randint(1, 6, n).astype(np.int64)
+            count = rng.randint(1, 500, n).astype(np.int64)
+            period = rng.choice([1, 60, 600], n).astype(np.int64)
+            qty = rng.randint(0, 3, n).astype(np.int64)
+            run_and_compare(
+                tpu, oracle, keys, burst, count, period, qty, now,
+                f"churn{seed}-step{step}",
+            )
+            now += int(rng.randint(0, 3 * NS))

@@ -35,20 +35,29 @@ class Rate:
         return Rate(period_ns)
 
     @staticmethod
+    def _per(base_ns: int, n: int) -> "Rate":
+        # The reference takes u64 here — non-positive counts are
+        # unrepresentable; reject them instead of producing a negative
+        # interval.
+        if n <= 0:
+            raise ValueError(f"rate count must be positive, got {n}")
+        return Rate(base_ns // n)
+
+    @staticmethod
     def per_second(n: int) -> "Rate":
-        return Rate(NS_PER_SEC // n)
+        return Rate._per(NS_PER_SEC, n)
 
     @staticmethod
     def per_minute(n: int) -> "Rate":
-        return Rate(60 * NS_PER_SEC // n)
+        return Rate._per(60 * NS_PER_SEC, n)
 
     @staticmethod
     def per_hour(n: int) -> "Rate":
-        return Rate(3600 * NS_PER_SEC // n)
+        return Rate._per(3600 * NS_PER_SEC, n)
 
     @staticmethod
     def per_day(n: int) -> "Rate":
-        return Rate(86400 * NS_PER_SEC // n)
+        return Rate._per(86400 * NS_PER_SEC, n)
 
     @staticmethod
     def from_count_and_period(count: int, period_seconds: int) -> "Rate":

@@ -23,6 +23,9 @@ class PeriodicStore(MapStore):
         cleanup_interval_ns: int = DEFAULT_CLEANUP_INTERVAL_SECS * NS_PER_SEC,
     ) -> None:
         super().__init__()
+        # API parity only: the reference preallocates its HashMap with this
+        # hint; Python dicts have no preallocation and this store has no
+        # capacity-based trigger (unlike AdaptiveStore).
         self.capacity = capacity
         self.cleanup_interval_ns = cleanup_interval_ns
         # Seeded lazily from the first operation's now_ns so virtual-time

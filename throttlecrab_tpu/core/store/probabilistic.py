@@ -23,6 +23,8 @@ class ProbabilisticStore(MapStore):
         cleanup_probability: int = PROBABILISTIC_CLEANUP_MODULO,
     ) -> None:
         super().__init__()
+        # API parity only (preallocation hint in the reference; see
+        # periodic.py).
         self.capacity = capacity
         self.cleanup_probability = cleanup_probability
         self._operations_count = 0
@@ -38,7 +40,13 @@ class ProbabilisticStore(MapStore):
     def _maybe_cleanup(self, now_ns: int) -> None:
         self._operations_count += 1
         hashed = (self._operations_count * _PRIME) & _U64_MASK
-        if hashed % self.cleanup_probability == 0:
+        # Rust's `is_multiple_of(0)` is `self == 0`: with probability 0 the
+        # store never cleans (the odd-prime product is never 0 mod 2^64).
+        if self.cleanup_probability == 0:
+            fire = hashed == 0
+        else:
+            fire = hashed % self.cleanup_probability == 0
+        if fire:
             self._sweep(now_ns)
 
 

@@ -1,0 +1,128 @@
+"""ctypes bridge to the C++ keymap (native/keymap.cpp).
+
+Compiles the shared library on first use with g++ (cached next to the
+source); falls back cleanly if no toolchain is available — the limiter then
+uses the pure-Python keymap.  No pybind11: the ABI is a small C surface and
+the batch arrays travel as numpy pointers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_SRC = _REPO_ROOT / "native" / "keymap.cpp"
+_LIB = _REPO_ROOT / "native" / "build" / "libtkkeymap.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_error
+    _LIB.parent.mkdir(parents=True, exist_ok=True)
+    if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+        cmd = [
+            "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+            str(_SRC), "-o", str(_LIB),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, FileNotFoundError) as e:
+            _build_error = str(e)
+            return None
+    lib = ctypes.CDLL(str(_LIB))
+    lib.tk_create.restype = ctypes.c_void_p
+    lib.tk_create.argtypes = [ctypes.c_int64]
+    lib.tk_destroy.argtypes = [ctypes.c_void_p]
+    lib.tk_len.restype = ctypes.c_int64
+    lib.tk_len.argtypes = [ctypes.c_void_p]
+    lib.tk_capacity.restype = ctypes.c_int64
+    lib.tk_capacity.argtypes = [ctypes.c_void_p]
+    lib.tk_grow.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tk_lookup_insert_batch.restype = ctypes.c_int64
+    lib.tk_lookup_insert_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.tk_free_slots.restype = ctypes.c_int64
+    lib.tk_free_slots.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lock:
+        if _lib is None and _build_error is None:
+            _lib = _build()
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+class NativeKeyMap:
+    """C++-backed key→slot table; drop-in for PyKeyMap via `resolve`."""
+
+    BYTES_KEYS = True
+
+    def __init__(self, capacity: int) -> None:
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native keymap unavailable: {_build_error}")
+        self._lib = lib
+        self._h = lib.tk_create(capacity)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.tk_destroy(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return self._lib.tk_len(self._h)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.tk_capacity(self._h)
+
+    def resolve(self, keys: Sequence[bytes], valid: np.ndarray):
+        """(slots, rank, is_last, n_full) for a batch of byte keys."""
+        n = len(keys)
+        buf = b"".join(keys)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+        slots = np.empty(n, np.int32)
+        rank = np.empty(n, np.int32)
+        is_last = np.empty(n, np.uint8)
+        valid_u8 = np.ascontiguousarray(valid, np.uint8)
+        n_full = self._lib.tk_lookup_insert_batch(
+            self._h,
+            buf,
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            n,
+            valid_u8.ctypes.data_as(ctypes.c_void_p),
+            slots.ctypes.data_as(ctypes.c_void_p),
+            rank.ctypes.data_as(ctypes.c_void_p),
+            is_last.ctypes.data_as(ctypes.c_void_p),
+        )
+        return slots, rank, is_last.astype(bool), int(n_full)
+
+    def free_slots(self, slot_indices: np.ndarray) -> int:
+        arr = np.ascontiguousarray(slot_indices, np.int32)
+        return int(
+            self._lib.tk_free_slots(
+                self._h, arr.ctypes.data_as(ctypes.c_void_p), len(arr)
+            )
+        )
+
+    def grow(self, new_capacity: int) -> None:
+        self._lib.tk_grow(self._h, new_capacity)

@@ -1,0 +1,27 @@
+"""TPU execution backend: batched GCRA kernels over an HBM bucket table."""
+
+from .keymap import PyKeyMap
+from .kernel import EMPTY_EXPIRY, gcra_batch, sweep_expired
+from .limiter import (
+    STATUS_INVALID_PARAMS,
+    STATUS_NEGATIVE_QUANTITY,
+    STATUS_OK,
+    BatchResult,
+    TpuRateLimiter,
+    derive_params,
+)
+from .table import BucketTable
+
+__all__ = [
+    "BatchResult",
+    "BucketTable",
+    "EMPTY_EXPIRY",
+    "PyKeyMap",
+    "STATUS_INVALID_PARAMS",
+    "STATUS_NEGATIVE_QUANTITY",
+    "STATUS_OK",
+    "TpuRateLimiter",
+    "derive_params",
+    "gcra_batch",
+    "sweep_expired",
+]

@@ -1,0 +1,170 @@
+"""Cleanup policies: when to run the expiry-compaction sweep.
+
+The reference couples cleanup policy to its three store types
+(`periodic.rs:128-142`, `adaptive_cleanup.rs:138-203`,
+`probabilistic.rs:110-125`); here the sweep itself is one jitted mask over
+the expiry column (kernel.sweep_expired) and the policy is a host object the
+engine consults between batches.  The trigger/adaptation rules are preserved
+verbatim, with one noted deviation: the adaptive expired-ratio trigger
+tracked per-op expired hits inside the Rust store; on the TPU path the
+equivalent signal (how many requests landed on expired entries) lives on the
+device, so the adaptive policy instead uses its time, operation-count and
+capacity-pressure triggers, plus the same interval doubling/halving from
+sweep yield.
+
+Policies are consulted with *batches* of operations (the engine processes
+thousands of requests per step), so the probabilistic fire-check covers the
+whole operation-count range at once.
+"""
+
+from __future__ import annotations
+
+from math import gcd
+from typing import Optional
+
+from ..core.i64 import NS_PER_SEC
+
+DEFAULT_CLEANUP_INTERVAL_SECS = 60
+MIN_CLEANUP_INTERVAL_SECS = 1
+MAX_CLEANUP_INTERVAL_SECS = 300
+ADAPTIVE_DEFAULT_INTERVAL_SECS = 5
+MAX_OPERATIONS_BEFORE_CLEANUP = 100_000
+PROBABILISTIC_CLEANUP_MODULO = 1000
+_PRIME = 2654435761
+
+
+class CleanupPolicy:
+    """Decides when the engine should sweep; see subclasses."""
+
+    def record_ops(self, n: int) -> None:
+        """Account `n` processed requests."""
+
+    def should_clean(self, now_ns: int, live_keys: int, capacity: int) -> bool:
+        raise NotImplementedError
+
+    def after_sweep(self, now_ns: int, removed: int, total_before: int) -> None:
+        """Observe a sweep's yield (for self-tuning policies)."""
+
+
+class PeriodicPolicy(CleanupPolicy):
+    """Fixed-interval sweeps (periodic.rs:128-142); default 60 s."""
+
+    def __init__(
+        self, interval_ns: int = DEFAULT_CLEANUP_INTERVAL_SECS * NS_PER_SEC
+    ) -> None:
+        self.interval_ns = interval_ns
+        self._next_ns: Optional[int] = None
+
+    def should_clean(self, now_ns, live_keys, capacity):
+        if self._next_ns is None:
+            self._next_ns = now_ns + self.interval_ns
+            return False
+        return now_ns >= self._next_ns
+
+    def after_sweep(self, now_ns, removed, total_before):
+        self._next_ns = now_ns + self.interval_ns
+
+
+class ProbabilisticPolicy(CleanupPolicy):
+    """Deterministic sampled sweeps (probabilistic.rs:110-125).
+
+    The per-op rule fires when `ops * 2654435761 % p == 0`, i.e. when ops is
+    a multiple of g = p / gcd(2654435761, p); over a batch of n ops the
+    policy fires iff the range (prev, prev + n] contains such a multiple.
+    """
+
+    def __init__(self, probability: int = PROBABILISTIC_CLEANUP_MODULO) -> None:
+        self.probability = probability
+        # probability 0 never fires (Rust is_multiple_of(0) ⇔ hash == 0,
+        # unreachable for the odd-prime product).
+        self._g = (
+            probability // gcd(_PRIME, probability) if probability > 0 else 0
+        )
+        self._ops = 0
+        self._fire = False
+
+    def record_ops(self, n):
+        prev = self._ops
+        self._ops += n
+        if self._g and self._ops // self._g > prev // self._g:
+            self._fire = True
+
+    def should_clean(self, now_ns, live_keys, capacity):
+        return self._fire
+
+    def after_sweep(self, now_ns, removed, total_before):
+        self._fire = False
+
+
+class AdaptivePolicy(CleanupPolicy):
+    """Self-tuning sweeps (adaptive_cleanup.rs:138-203).
+
+    Triggers: time >= next_cleanup, ops since last sweep >= max_operations,
+    or live keys above 3/4 of table capacity.  After each sweep the interval
+    doubles (nothing removed) or halves (over half removed), clamped to
+    [min_interval, max_interval].
+    """
+
+    def __init__(
+        self,
+        min_interval_ns: int = MIN_CLEANUP_INTERVAL_SECS * NS_PER_SEC,
+        max_interval_ns: int = MAX_CLEANUP_INTERVAL_SECS * NS_PER_SEC,
+        max_operations: int = MAX_OPERATIONS_BEFORE_CLEANUP,
+    ) -> None:
+        self.min_interval_ns = min_interval_ns
+        self.max_interval_ns = max_interval_ns
+        self.max_operations = max_operations
+        self.current_interval_ns = ADAPTIVE_DEFAULT_INTERVAL_SECS * NS_PER_SEC
+        self._next_ns: Optional[int] = None
+        self._ops = 0
+
+    def record_ops(self, n):
+        self._ops += n
+
+    def should_clean(self, now_ns, live_keys, capacity):
+        if self._next_ns is None:
+            self._next_ns = now_ns + self.current_interval_ns
+        if now_ns >= self._next_ns:
+            return True
+        if self._ops >= self.max_operations:
+            return True
+        if live_keys > capacity * 3 // 4:
+            return True
+        return False
+
+    def after_sweep(self, now_ns, removed, total_before):
+        if removed == 0:
+            self.current_interval_ns = min(
+                self.current_interval_ns * 2, self.max_interval_ns
+            )
+        elif removed > total_before * 0.5:
+            self.current_interval_ns = max(
+                self.current_interval_ns // 2, self.min_interval_ns
+            )
+        self._next_ns = now_ns + self.current_interval_ns
+        self._ops = 0
+
+
+def make_policy(name: str, **kwargs) -> CleanupPolicy:
+    """Factory mirroring the server's store selection (store.rs:57-87)."""
+    name = name.lower()
+    if name == "periodic":
+        interval = kwargs.get("cleanup_interval_secs", DEFAULT_CLEANUP_INTERVAL_SECS)
+        return PeriodicPolicy(int(interval * NS_PER_SEC))
+    if name == "probabilistic":
+        return ProbabilisticPolicy(
+            int(kwargs.get("cleanup_probability", PROBABILISTIC_CLEANUP_MODULO))
+        )
+    if name == "adaptive":
+        return AdaptivePolicy(
+            min_interval_ns=int(
+                kwargs.get("min_interval_secs", MIN_CLEANUP_INTERVAL_SECS) * NS_PER_SEC
+            ),
+            max_interval_ns=int(
+                kwargs.get("max_interval_secs", MAX_CLEANUP_INTERVAL_SECS) * NS_PER_SEC
+            ),
+            max_operations=int(
+                kwargs.get("max_operations", MAX_OPERATIONS_BEFORE_CLEANUP)
+            ),
+        )
+    raise ValueError(f"unknown cleanup policy: {name!r}")

@@ -1,0 +1,425 @@
+"""Batched GCRA decision kernel.
+
+One jitted function replaces the reference's request-at-a-time actor hot loop
+(`rate_limiter.rs:146-238` behind `actor.rs:217-236`): it takes a tensor of B
+requests (slot index + per-request GCRA parameters), gathers the per-slot
+state (TAT + expiry) from the HBM-resident bucket table, computes all B
+decisions with pure elementwise + segment ops (VPU work — no sort, no
+data-dependent control flow), scatters the surviving state back, and returns
+per-request results.  Buffers are donated, so the table is updated in place
+batch after batch without reallocation.
+
+Intra-batch duplicate keys
+==========================
+
+The reference serializes duplicate keys through its single-threaded CAS loop;
+a batched kernel must reproduce that *sequential* semantics inside one batch.
+The host keymap — which already walks every key to resolve slots — emits the
+segment structure for free: for each request, `rank` (its key's occurrence
+number within the batch) and `is_last` (whether it is the key's final
+occurrence).  With that, the sequential fold per key is evaluated in closed
+form — no device-side sort and no segment reductions (TPU scatter-adds
+serialize; a measured ~0.5 ms per segment_sum).  For a segment with uniform
+parameters (the engine guarantees each key has one (emission, tolerance,
+quantity) per batch):
+
+- **Main case** (`inc > 0 and tol > 0`): an allowed request advances TAT by
+  `inc = emission * quantity`, a denied one leaves it unchanged, and the
+  allow-condition `tat + inc <= now + tol` is monotone in the number of prior
+  allows — so the allowed set is exactly a prefix of the segment whose length
+  has the direct closed form `m_raw = floor((now + tol - t0) / inc)`.  The
+  request at rank r is allowed iff `r < m_raw`; a denied request's observed
+  TAT is `t0 + m_raw*inc` (denial implies `m_raw <= rank`, so the segment
+  total never exceeds m_raw); and the write-back at the `is_last` position
+  uses segment size `rank + 1`.  Every output follows per-position — no
+  cross-position communication at all.  No mid-batch expiry is possible
+  here: every allowed write has ttl >= tol > 0.
+
+- **Degenerate case** (`inc == 0 or tol == 0`, i.e. quantity=0 probes,
+  burst=1, or sub-ns emission intervals): an allowed write can carry ttl == 0
+  and expire *instantly* (the burst-1 quirk pinned in
+  tests/test_gcra_math.py::test_burst_one_ttl_zero_quirk), or carry a
+  negative raw ttl that wraps to an effectively-immortal entry whose stored
+  TAT then gets clamped *up* on re-read.  Model each request as a transition
+  on the "view" v (the clamped/initialised TAT it observes): denial leaves v
+  unchanged (absorbing — the next request sees the identical state), a dead
+  write resets v to the fresh-miss value `now - emission`, and a live write
+  moves v to `max(new_tat, now - tolerance)`.  Within one batch `now` is
+  fixed, so the view orbit is eventually periodic with pre-period <= 1 and
+  period <= 2: the entire segment is described by the three views
+  v0, v1 = f(v0), v2 = f(v1) (with v3 = v1), and every request's outputs
+  select among those three by rank parity.  All closed form, no scan.
+
+Launch amortization
+===================
+
+The serving tunnel to the TPU has a multi-millisecond fixed cost per launch
+and per device→host fetch, so the engine processes K micro-batches per
+launch with `gcra_scan` (a `lax.scan` over stacked [K, B] inputs, each
+sub-batch with its own server timestamp) and fetches one stacked [K, 4, B]
+output.  Single-batch `gcra_batch` is the same body without the scan.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .sat import I64_MAX, div_trunc, sat_add, sat_mul_nonneg, sat_sub
+
+EMPTY_EXPIRY = -(1 << 63)  # expiry sentinel: always in the past
+
+_U32 = (1 << 32) - 1
+
+
+def pack_state(tat, expiry):
+    """(i64[N], i64[N]) → i32[N, 4] rows [tat_lo, tat_hi, exp_lo, exp_hi].
+
+    TPU scatter cost is per-row with poor i64 lowering; one 4×i32 row
+    scatter is ~4.5x cheaper than two separate i64 scatters (measured on
+    v5e), so the table lives split into 32-bit halves.
+    """
+    def split(x):
+        lo = (x & _U32).astype(jnp.uint32).astype(jnp.int32)
+        hi = (x >> 32).astype(jnp.int32)
+        return lo, hi
+
+    tat_lo, tat_hi = split(tat)
+    exp_lo, exp_hi = split(expiry)
+    return jnp.stack([tat_lo, tat_hi, exp_lo, exp_hi], axis=-1)
+
+
+def unpack_state(state):
+    """i32[..., 4] rows → (tat i64[...], expiry i64[...])."""
+    def join(lo, hi):
+        return (hi.astype(jnp.int64) << 32) | (lo.astype(jnp.int64) & _U32)
+
+    return (
+        join(state[..., 0], state[..., 1]),
+        join(state[..., 2], state[..., 3]),
+    )
+
+
+def _request_outputs(t, inc, emission, tol, now):
+    """Outcome of one GCRA check from state `t` (all i64, vectorized).
+
+    Mirrors rate_limiter.rs:168-238 for a single request whose (possibly
+    clamped or miss-initialised) TAT is `t`.
+    Returns (allowed, remaining, reset_after, retry_after, new_tat, ttl).
+    """
+    new_tat = sat_add(t, inc)
+    allow_at = sat_sub(new_tat, tol)
+    allowed = now >= allow_at
+    cur = jnp.where(allowed, new_tat, t)
+    burst_limit = sat_add(now, tol)
+    room = sat_sub(burst_limit, cur)
+    remaining = jnp.where(
+        emission > 0, jnp.maximum(div_trunc(room, emission), 0), 0
+    )
+    reset_after = jnp.maximum(sat_add(sat_sub(cur, now), tol), 0)
+    retry_after = jnp.where(
+        allowed, 0, jnp.maximum(sat_sub(allow_at, now), 0)
+    )
+    ttl = sat_add(sat_sub(new_tat, now), tol)
+    return allowed, remaining, reset_after, retry_after, new_tat, ttl
+
+
+def _gcra_body(state, batch, *, with_degen=True, compact=False):
+    """Decide one micro-batch; returns (state, out).
+
+    `state` is the packed i32[N, 4] table (see pack_state).
+
+    with_degen=False compiles out the degenerate-case machinery — legal only
+    when the host certifies the batch has no quantity-0, burst-1 or
+    zero-emission requests (the engine checks per batch; ~40% less VPU work).
+
+    compact=True returns i32[4, B] (allowed, remaining, reset_after_secs,
+    retry_after_secs) instead of i64 nanosecond outputs — the exact wire
+    semantics of the reference server, whose responses are whole seconds
+    (types.rs:87-97) and whose gRPC proto is int32 (throttlecrab.proto:15-21).
+    Values saturate at i32::MAX.  Halves the device→host bytes per decision.
+    """
+    (slots, rank, is_last, emission, tolerance, quantity, valid, now) = batch
+    N = state.shape[0]
+    now = now.astype(jnp.int64)
+
+    s = jnp.clip(slots, 0, N - 1).astype(jnp.int32)
+    stored_tat, stored_exp = unpack_state(state[s])
+    v = valid
+    live = v & (stored_exp > now)
+
+    em = emission
+    tol = tolerance
+    inc = sat_mul_nonneg(em, quantity)
+
+    # Initial TAT of the segment: stored value clamped to now - tol, or the
+    # first-touch value now - emission (rate_limiter.rs:158-166).  Identical
+    # at every position of a segment since all inputs are per-slot uniform.
+    t0 = jnp.where(
+        live, jnp.maximum(stored_tat, sat_sub(now, tol)), sat_sub(now, em)
+    )
+
+    # ---- main case: prefix closed form ------------------------------------
+    # m_raw = how many sequential allows fit before the limit; rank r is
+    # allowed iff r < m_raw.  Division is exact (inc > 0 in the main case).
+    num = sat_sub(sat_add(now, tol), t0)
+    m_raw = jnp.maximum(div_trunc(num, inc), 0)
+    allowed_main = rank < m_raw
+
+    new_tat_r = sat_add(t0, sat_mul_nonneg(rank + 1, inc))
+    # Observed TAT: own new_tat when allowed; t0 + m_raw*inc when denied
+    # (all m_raw allowed requests precede any denied one).
+    tat_denied = sat_add(t0, sat_mul_nonneg(m_raw, inc))
+    cur_main = jnp.where(allowed_main, new_tat_r, tat_denied)
+    # Segment write-back, evaluated at the is_last position where the
+    # segment size is rank + 1.
+    tat_fin_main = sat_add(
+        t0, sat_mul_nonneg(jnp.minimum(m_raw, rank + 1), inc)
+    )
+
+    burst_limit = sat_add(now, tol)
+    room_main = sat_sub(burst_limit, cur_main)
+    remaining_main = jnp.where(
+        em > 0, jnp.maximum(div_trunc(room_main, em), 0), 0
+    )
+    reset_main = jnp.maximum(sat_add(sat_sub(cur_main, now), tol), 0)
+    retry_main = jnp.where(
+        allowed_main,
+        0,
+        jnp.maximum(sat_sub(sat_sub(sat_add(cur_main, inc), tol), now), 0),
+    )
+
+    # ---- degenerate case: three-view closed form ---------------------------
+    if not with_degen:
+        return _finish(
+            state, s, N, now, tol,
+            allowed_main & v,
+            remaining_main,
+            reset_main,
+            retry_main,
+            (m_raw >= 1) & v & is_last,
+            tat_fin_main,
+            compact,
+        )
+
+    degen = (inc == 0) | (tol == 0)
+
+    def view_step(t):
+        """One request's outputs from view t, plus the successor view.
+
+        A write "dies" iff its raw ttl is exactly 0 (ttl < 0 wraps to a huge
+        u64 duration in the reference — effectively immortal, see
+        rate_limiter.rs:179-183 + core/i64.py wrap_u64); a live write's
+        stored TAT is re-clamped to now - tol by the next reader.
+        """
+        outs = _request_outputs(t, inc, em, tol, now)
+        allowed_t, _, _, _, new_t, ttl_t = outs
+        dead = allowed_t & (ttl_t == 0)
+        t_next = jnp.where(
+            ~allowed_t,
+            t,
+            jnp.where(
+                dead, sat_sub(now, em), jnp.maximum(new_t, sat_sub(now, tol))
+            ),
+        )
+        return outs, t_next
+
+    outs0, v1 = view_step(t0)
+    outs1, v2 = view_step(v1)
+    outs2, _ = view_step(v2)
+    a0, a1, a2 = outs0[0], outs1[0], outs2[0]
+
+    def pick(main, o0, o1, o2):
+        """Select a degen output by rank: v0 at rank 0; then v1/v2 by parity
+        until the first denial, which is absorbing (the view stops moving)."""
+        alternating = jnp.where((rank - 1) % 2 == 0, o1, o2)
+        tail = jnp.where(rank == 1, o1, jnp.where(a2, alternating, o2))
+        degen_out = jnp.where(
+            ~a0, o0, jnp.where(~a1, jnp.where(rank == 0, o0, o1),
+                               jnp.where(rank == 0, o0, tail))
+        )
+        return jnp.where(degen, degen_out, main)
+
+    allowed_out = pick(allowed_main, a0, a0 & a1, a0 & a1 & a2) & v
+    remaining_out = pick(remaining_main, outs0[1], outs1[1], outs2[1])
+    reset_out = pick(reset_main, outs0[2], outs1[2], outs2[2])
+    retry_out = pick(retry_main, outs0[3], outs1[3], outs2[3])
+
+    # ---- write-back --------------------------------------------------------
+    # Evaluated at the is_last position, where own rank == segment size - 1.
+
+    # Degenerate final state: the write of the last *allowed* rank L.
+    # L = 0 if only rank 0 got through (or k == 1), L = 1 if denial started
+    # at rank 2, else L = k-1 with the view alternating v1/v2.
+    new0_t, new1_t, new2_t = outs0[4], outs1[4], outs2[4]
+    last_rank = rank
+    alt_last = jnp.where((last_rank - 1) % 2 == 0, new1_t, new2_t)
+    tat_fin_degen = jnp.where(
+        (last_rank == 0) | ~a1,
+        new0_t,
+        jnp.where(~a2 | (last_rank == 1), new1_t, alt_last),
+    )
+    wrote_degen = a0
+
+    wrote = jnp.where(degen, wrote_degen, m_raw >= 1) & v & is_last
+    tat_fin = jnp.where(degen, tat_fin_degen, tat_fin_main)
+    return _finish(
+        state, s, N, now, tol,
+        allowed_out, remaining_out, reset_out, retry_out,
+        wrote, tat_fin, compact,
+    )
+
+
+_I32_MAX = (1 << 31) - 1
+_NS_PER_SEC = 1_000_000_000
+
+
+def _finish(
+    state, s, N, now, tol, allowed, remaining, reset_after,
+    retry_after, wrote, tat_fin, compact,
+):
+    """Write back the surviving state (one packed-row scatter) and stack the
+    outputs."""
+    ttl_fin = sat_add(sat_sub(tat_fin, now), tol)
+    # expiry = now + ttl; ttl < 0 wraps to a ~584-year duration in the
+    # reference, which we saturate to "never expires".
+    expiry_fin = jnp.where(ttl_fin < 0, I64_MAX, sat_add(tat_fin, tol))
+
+    # Suppressed writes land in the table's scratch tail (the last B rows,
+    # beyond every real slot) at distinct indices, keeping the
+    # unique_indices promise honest.
+    B = s.shape[0]
+    scratch = N - B + jnp.arange(B, dtype=jnp.int32)
+    scatter_idx = jnp.where(wrote, s, scratch).astype(jnp.int32)
+    rows = pack_state(tat_fin, expiry_fin)
+    state = state.at[scatter_idx].set(rows, unique_indices=True, mode="drop")
+
+    # One stacked output → one device-to-host fetch.
+    if compact:
+        out = jnp.stack(
+            [
+                allowed.astype(jnp.int32),
+                jnp.minimum(remaining, _I32_MAX).astype(jnp.int32),
+                jnp.minimum(reset_after // _NS_PER_SEC, _I32_MAX).astype(
+                    jnp.int32
+                ),
+                jnp.minimum(retry_after // _NS_PER_SEC, _I32_MAX).astype(
+                    jnp.int32
+                ),
+            ]
+        )
+    else:
+        out = jnp.stack(
+            [
+                allowed.astype(jnp.int64),
+                remaining.astype(jnp.int64),
+                reset_after.astype(jnp.int64),
+                retry_after.astype(jnp.int64),
+            ]
+        )
+    return state, out
+
+
+@partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("with_degen", "compact")
+)
+def gcra_batch(
+    state, slots, rank, is_last, emission, tolerance, quantity,
+    valid, now, *, with_degen=True, compact=False,
+):
+    """Decide B rate-limit requests against the bucket table.
+
+    Args:
+      state:     i32[N, 4] packed (tat, expiry) rows (donated; see
+                 pack_state).  The last B rows are scratch for suppressed
+                 writes — real slots must stay below N - B.
+      slots:     i32[B] slot index per request.
+      rank:      i32[B] occurrence number of this request for its key.
+      is_last:   bool[B] final occurrence of this key in the batch.
+      emission:  i64[B] emission interval ns (>= 0; host f64 pipeline).
+      tolerance: i64[B] delay variation tolerance ns.
+      quantity:  i64[B] tokens requested (>= 0; validation is host-side).
+      valid:     bool[B] False for padding / rejected requests.
+      now:       i64 scalar, ns since epoch (server-side timestamp).
+
+    Duplicate slots within the batch MUST share (emission, tolerance,
+    quantity); the engine defers conflicting requests to a later batch to
+    preserve exact arrival-order semantics.
+
+    Returns (state, out[4, B]) where out rows are (allowed, remaining,
+    reset_after, retry_after).
+    """
+    return _gcra_body(
+        state,
+        (
+            slots,
+            rank.astype(jnp.int64),
+            is_last,
+            emission,
+            tolerance,
+            quantity,
+            valid,
+            jnp.asarray(now, jnp.int64),
+        ),
+        with_degen=with_degen,
+        compact=compact,
+    )
+
+
+@partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("with_degen", "compact")
+)
+def gcra_scan(
+    state, slots, rank, is_last, emission, tolerance, quantity,
+    valid, now, *, with_degen=True, compact=False,
+):
+    """K micro-batches in one launch: inputs stacked [K, B], now is i64[K].
+
+    Amortizes the fixed per-launch and per-fetch cost of the serving tunnel;
+    each sub-batch carries its own server timestamp and sees the table state
+    left by the previous one (lax.scan carry), exactly as if dispatched
+    separately.  Returns (state, out[K, 4, B]).
+    """
+
+    def step(state, batch):
+        state, out = _gcra_body(
+            state, batch, with_degen=with_degen, compact=compact
+        )
+        return state, out
+
+    state, outs = jax.lax.scan(
+        step,
+        state,
+        (
+            slots,
+            rank.astype(jnp.int64),
+            is_last,
+            emission,
+            tolerance,
+            quantity,
+            valid,
+            now.astype(jnp.int64),
+        ),
+    )
+    return state, outs
+
+
+@partial(jax.jit, donate_argnums=(1,), static_argnames=("capacity",))
+def sweep_expired(now, state, capacity):
+    """Cleanup-as-compaction: vacate every expired slot, report which.
+
+    The reference's `retain(|_, (_, expiry)| expiry > now)` sweep
+    (`periodic.rs:131-141`) becomes a boolean mask over the expiry column;
+    the host frees the corresponding key→slot entries from the returned
+    mask (first `capacity` rows only — the rest is scratch).
+    """
+    now = jnp.asarray(now, jnp.int64)
+    _, expiry = unpack_state(state)
+    expired = expiry <= now
+    empty_rows = pack_state(
+        jnp.zeros_like(expiry), jnp.full_like(expiry, EMPTY_EXPIRY)
+    )
+    state = jnp.where(expired[:, None], empty_rows, state)
+    return state, expired[:capacity]

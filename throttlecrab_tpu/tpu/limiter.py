@@ -1,0 +1,323 @@
+"""TpuRateLimiter: the batched, TPU-backed rate-limiting engine.
+
+The TPU-native equivalent of `RateLimiter<S: Store>` (`rate_limiter.rs:42-58`)
+plus the actor's serialized hot loop: requests arrive as whole batches,
+string keys are resolved to table slots on the host, GCRA parameters are
+derived with the reference's exact f64 pipeline, and all decisions execute in
+one jitted device kernel against the HBM bucket table.
+
+Exactness notes vs the scalar oracle (core/rate_limiter.py):
+
+- Per-request validation errors (negative quantity / non-positive params) are
+  reported in `BatchResult.status` instead of raising, since one bad request
+  must not fail its batchmates (each transport maps status → its protocol
+  error, like the reference server does per request).
+- Duplicate keys in one batch are serialized with exact arrival-order
+  semantics (see kernel.py).  A key whose *parameters change mid-batch* is
+  split into consecutive param-runs processed as sub-rounds, preserving
+  order.
+- `now_ns` is a single server-side timestamp per batch (the reference server
+  also stamps every request at the transport, `http.rs:127-128`).  The
+  scalar-compat wrapper applies the pre-epoch clock-skew fallback per call.
+- Emission intervals are clamped to i64::MAX ns (~292 years); the reference
+  wraps them to negative i64 through `as_nanos() as i64` in that range.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.errors import InternalError, InvalidRateLimit, NegativeQuantity
+from ..core.rate_limiter import RateLimitResult, normalize_now_ns
+from .keymap import PyKeyMap
+from .table import BucketTable
+
+
+def _native_available() -> bool:
+    from ..native import native_available
+
+    return native_available()
+
+I64_MAX = (1 << 63) - 1
+
+STATUS_OK = 0
+STATUS_NEGATIVE_QUANTITY = 1
+STATUS_INVALID_PARAMS = 2
+
+
+def segment_info(slots, mask):
+    """Per-request duplicate-key structure for the kernel.
+
+    For each masked-in request: `rank` = its key's occurrence number within
+    the batch, `is_last` = whether it is the key's final occurrence.  One
+    dict pass on the host — the C++ keymap computes this for free during
+    slot resolution.
+    """
+    n = len(slots)
+    rank = np.zeros(n, np.int32)
+    is_last = np.ones(n, bool)
+    state: dict = {}
+    for i in np.flatnonzero(mask):
+        sl = int(slots[i])
+        st = state.get(sl)
+        if st is None:
+            state[sl] = [1, i]
+        else:
+            rank[i] = st[0]
+            st[0] += 1
+            is_last[st[1]] = False
+            st[1] = i
+    return rank, is_last
+
+
+@dataclass
+class BatchResult:
+    """Per-request outcomes of one batch (numpy arrays, length B)."""
+
+    allowed: np.ndarray
+    limit: np.ndarray
+    remaining: np.ndarray
+    reset_after_ns: np.ndarray
+    retry_after_ns: np.ndarray
+    status: np.ndarray
+
+
+def derive_params(max_burst, count_per_period, period):
+    """(emission_ns, tolerance_ns, invalid) via the reference f64 pipeline.
+
+    Mirrors `rate/mod.rs:164-176` (f64 multiply/divide, truncating u64 cast)
+    and `rate_limiter.rs:122` (tolerance = emission * ((burst-1) as u32),
+    with the product truncated to 64 bits).
+    """
+    max_burst = np.asarray(max_burst, np.int64)
+    count_per_period = np.asarray(count_per_period, np.int64)
+    period = np.asarray(period, np.int64)
+
+    invalid = (max_burst <= 0) | (count_per_period <= 0) | (period <= 0)
+    safe_count = np.where(count_per_period == 0, 1, count_per_period)
+    emission_f = period.astype(np.float64) * 1e9 / safe_count.astype(np.float64)
+    emission = np.where(
+        emission_f >= float(1 << 63),
+        I64_MAX,
+        emission_f.astype(np.int64),
+    )
+    emission = np.where(emission < 0, 0, emission)
+
+    b32 = (max_burst - 1).astype(np.uint64) & np.uint64(0xFFFFFFFF)
+    tolerance = (emission.astype(np.uint64) * b32).astype(np.int64)
+    return emission, tolerance, invalid
+
+
+class TpuRateLimiter:
+    """Batched GCRA over a device bucket table + host keymap."""
+
+    MIN_PAD = 16
+
+    def __init__(
+        self,
+        capacity: int = 1 << 20,
+        keymap="python",
+        device=None,
+        auto_grow: bool = True,
+    ) -> None:
+        """`keymap` selects the host key→slot backend: "python" (default,
+        hashable keys of any kind), "native" (C++ batch resolver, bytes
+        keys), "auto" (native when the toolchain built it), or a ready
+        keymap object exposing resolve/free_slots/grow/capacity."""
+        self.table = BucketTable(capacity, device=device)
+        if keymap == "auto":
+            keymap = "native" if _native_available() else "python"
+        if keymap == "python":
+            self.keymap = PyKeyMap(capacity)
+        elif keymap == "native":
+            from ..native import NativeKeyMap
+
+            self.keymap = NativeKeyMap(capacity)
+        else:
+            self.keymap = keymap
+        self.auto_grow = auto_grow
+
+    # ------------------------------------------------------------------ #
+
+    def rate_limit_batch(
+        self,
+        keys,
+        max_burst,
+        count_per_period,
+        period,
+        quantity,
+        now_ns: int,
+    ) -> BatchResult:
+        """Decide a batch of requests at one server timestamp.
+
+        `keys` is a sequence of hashable keys (str/bytes); the numeric
+        parameters broadcast to its length.  `now_ns` must be >= 0.
+        """
+        if now_ns < 0:
+            raise ValueError(
+                "batch now_ns must be non-negative; apply "
+                "normalize_now_ns per request for pre-epoch clocks"
+            )
+        n = len(keys)
+        if getattr(self.keymap, "BYTES_KEYS", False):
+            keys = [k.encode() if isinstance(k, str) else k for k in keys]
+        max_burst = np.broadcast_to(np.asarray(max_burst, np.int64), (n,))
+        count_per_period = np.broadcast_to(
+            np.asarray(count_per_period, np.int64), (n,)
+        )
+        period = np.broadcast_to(np.asarray(period, np.int64), (n,))
+        quantity = np.broadcast_to(np.asarray(quantity, np.int64), (n,))
+
+        status = np.zeros(n, np.uint8)
+        emission, tolerance, invalid = derive_params(
+            max_burst, count_per_period, period
+        )
+        status[invalid] = STATUS_INVALID_PARAMS
+        status[quantity < 0] = STATUS_NEGATIVE_QUANTITY
+        valid = status == STATUS_OK
+
+        slots, rank0, is_last0, n_full = self.keymap.resolve(keys, valid)
+        while n_full:
+            if not self.auto_grow:
+                raise InternalError("bucket table full")
+            new_capacity = max(self.keymap.capacity * 2, 1024)
+            self.keymap.grow(new_capacity)
+            self.table.grow(new_capacity)
+            missing = valid & (slots == -1)
+            slots2, _, _, n_full = self.keymap.resolve(keys, missing)
+            slots = np.where(missing, slots2, slots)
+            # Segment info must cover the merged batch.
+            rank0, is_last0 = segment_info(slots, valid)
+
+        rounds = self._conflict_rounds(slots, valid, emission, tolerance, quantity)
+
+        pad = max(self.MIN_PAD, 1 << (n - 1).bit_length())
+        slots_p = np.zeros(pad, np.int32)
+        slots_p[:n] = slots
+        em_p = np.zeros(pad, np.int64)
+        em_p[:n] = emission
+        tol_p = np.zeros(pad, np.int64)
+        tol_p[:n] = tolerance
+        q_p = np.zeros(pad, np.int64)
+        q_p[:n] = quantity
+
+        allowed = np.zeros(n, bool)
+        remaining = np.zeros(n, np.int64)
+        reset_after = np.zeros(n, np.int64)
+        retry_after = np.zeros(n, np.int64)
+
+        n_rounds = int(rounds.max()) + 1 if n else 1
+        for r in range(n_rounds):
+            mask = valid & (rounds == r)
+            if not mask.any():
+                continue
+            valid_p = np.zeros(pad, bool)
+            valid_p[:n] = mask
+            if n_rounds == 1:
+                # Segment info came for free from the keymap pass.
+                rank = np.zeros(pad, np.int32)
+                rank[:n] = rank0
+                is_last = np.ones(pad, bool)
+                is_last[:n] = is_last0
+            else:
+                rank, is_last = segment_info(slots_p, valid_p)
+            out_dev = self.table.check_batch(
+                slots_p, rank, is_last, em_p, tol_p, q_p, valid_p, now_ns
+            )
+            # One device→host fetch per round; rounds beyond 0 are rare.
+            out = np.asarray(out_dev)[:, :n]
+            allowed[mask] = out[0][mask] != 0
+            remaining[mask] = out[1][mask]
+            reset_after[mask] = out[2][mask]
+            retry_after[mask] = out[3][mask]
+
+        return BatchResult(
+            allowed=allowed,
+            limit=np.where(valid, max_burst, 0),
+            remaining=remaining,
+            reset_after_ns=reset_after,
+            retry_after_ns=retry_after,
+            status=status,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def rate_limit(
+        self,
+        key,
+        max_burst: int,
+        count_per_period: int,
+        period: int,
+        quantity: int,
+        now_ns: int,
+    ):
+        """Scalar-compat API mirroring core.RateLimiter.rate_limit."""
+        if quantity < 0:
+            raise NegativeQuantity(quantity)
+        if max_burst <= 0 or count_per_period <= 0 or period <= 0:
+            raise InvalidRateLimit()
+        now_ns = normalize_now_ns(now_ns, period)
+        res = self.rate_limit_batch(
+            [key], [max_burst], [count_per_period], [period], [quantity], now_ns
+        )
+        return bool(res.allowed[0]), RateLimitResult(
+            limit=int(res.limit[0]),
+            remaining=int(res.remaining[0]),
+            reset_after_ns=int(res.reset_after_ns[0]),
+            retry_after_ns=int(res.retry_after_ns[0]),
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def sweep(self, now_ns: int) -> int:
+        """Run a cleanup sweep; returns the number of slots freed."""
+        expired = self.table.sweep(now_ns)
+        return self.keymap.free_slots(np.flatnonzero(expired))
+
+    def __len__(self) -> int:
+        return len(self.keymap)
+
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _conflict_rounds(slots, valid, emission, tolerance, quantity):
+        """Arrival-order rounds for keys whose params change mid-batch.
+
+        Round r holds each key's r-th maximal run of identical parameters,
+        so processing rounds in order reproduces the reference's sequential
+        per-request semantics exactly.
+        """
+        n = len(slots)
+        rounds = np.zeros(n, np.int32)
+        if n == 0:
+            return rounds
+        vslots = slots[valid]
+        if len(np.unique(vslots)) == len(vslots):
+            return rounds  # no duplicates at all: single round
+
+        uniq, first_idx, inv = np.unique(slots, return_index=True, return_inverse=True)
+        canon = first_idx[inv]
+        conflict = valid & (
+            (emission != emission[canon])
+            | (tolerance != tolerance[canon])
+            | (quantity != quantity[canon])
+        )
+        if not conflict.any():
+            return rounds
+
+        state: dict = {}
+        for i in np.flatnonzero(valid):
+            sl = int(slots[i])
+            p = (int(emission[i]), int(tolerance[i]), int(quantity[i]))
+            st = state.get(sl)
+            if st is None:
+                state[sl] = [p, 0]
+            elif st[0] == p:
+                rounds[i] = st[1]
+            else:
+                st[0] = p
+                st[1] += 1
+                rounds[i] = st[1]
+        return rounds

@@ -1,0 +1,47 @@
+"""Saturating i64 arithmetic lattices for the device kernels.
+
+XLA's int64 ops wrap on overflow (two's complement); the GCRA contract needs
+Rust-style saturating semantics (`rate_limiter.rs:160-238`).  These helpers
+detect wrap and clamp, entirely with elementwise ops (VPU-friendly, no
+data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+I64_MAX = (1 << 63) - 1
+I64_MIN = -(1 << 63)
+
+
+def sat_add(a, b):
+    """i64 saturating a + b."""
+    s = a + b
+    pos_of = (a > 0) & (b > 0) & (s < 0)
+    neg_of = (a < 0) & (b < 0) & (s >= 0)
+    return jnp.where(pos_of, I64_MAX, jnp.where(neg_of, I64_MIN, s))
+
+
+def sat_sub(a, b):
+    """i64 saturating a - b."""
+    d = a - b
+    pos_of = (a >= 0) & (b < 0) & (d < 0)
+    neg_of = (a < 0) & (b > 0) & (d >= 0)
+    return jnp.where(pos_of, I64_MAX, jnp.where(neg_of, I64_MIN, d))
+
+
+def sat_mul_nonneg(a, b):
+    """i64 saturating a * b for a, b >= 0 (the only case GCRA needs)."""
+    safe_b = jnp.maximum(b, 1)
+    overflow = (b > 0) & (a > I64_MAX // safe_b)
+    return jnp.where(overflow, I64_MAX, a * b)
+
+
+def div_trunc(a, b):
+    """i64 division truncating toward zero (Rust `/`); b must be > 0.
+
+    `lax.div` on integers matches C semantics (truncation), unlike
+    jnp.floor_divide.
+    """
+    return lax.div(a, jnp.maximum(b, 1))

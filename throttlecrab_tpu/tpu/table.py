@@ -1,0 +1,155 @@
+"""HBM-resident bucket table: the TPU replacement for the HashMap stores.
+
+Structure-of-Arrays layout instead of the reference's
+`HashMap<String, (i64, Option<SystemTime>)>` (`periodic.rs:39-47`): string
+keys are resolved to dense slot indices on the host (see keymap.py); the
+device only ever sees integer slots.  Each slot's (TAT, expiry) pair is
+stored as one packed i32[4] row — TPU scatters cost per *row*, and one 4×i32
+row write is ~4.5x cheaper than two separate i64 scatters (see
+kernel.pack_state).  16 bytes of HBM per slot — 1M keys is 16 MB — plus a
+scratch tail of `SCRATCH` rows that absorbs suppressed writes at unique
+indices.
+
+All mutation goes through the donated-buffer kernels in kernel.py, so the
+array is updated in place batch after batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kernel import (
+    EMPTY_EXPIRY,
+    gcra_batch,
+    gcra_scan,
+    pack_state,
+    sweep_expired,
+    unpack_state,
+)
+
+
+class BucketTable:
+    """Per-slot GCRA state on a single device."""
+
+    SCRATCH = 1 << 16  # max batch size; scratch rows for suppressed writes
+
+    def __init__(self, capacity: int, device=None) -> None:
+        self.capacity = capacity
+        self.device = device
+        self.state = self._alloc(capacity + self.SCRATCH)
+
+    def _alloc(self, rows: int) -> jax.Array:
+        ctx = (
+            jax.default_device(self.device)
+            if self.device is not None
+            else _nullcontext()
+        )
+        with ctx:
+            return pack_state(
+                jnp.zeros((rows,), jnp.int64),
+                jnp.full((rows,), EMPTY_EXPIRY, jnp.int64),
+            )
+
+    @property
+    def tat(self) -> jax.Array:
+        """i64 TAT column (diagnostics/tests; excludes scratch)."""
+        return unpack_state(self.state)[0][: self.capacity]
+
+    @property
+    def expiry(self) -> jax.Array:
+        """i64 expiry column (diagnostics/tests; excludes scratch)."""
+        return unpack_state(self.state)[1][: self.capacity]
+
+    def check_batch(
+        self,
+        slots: np.ndarray,
+        rank: np.ndarray,
+        is_last: np.ndarray,
+        emission: np.ndarray,
+        tolerance: np.ndarray,
+        quantity: np.ndarray,
+        valid: np.ndarray,
+        now_ns: int,
+        with_degen: bool = True,
+        compact: bool = False,
+    ) -> jax.Array:
+        """Run one decision batch; updates the table state in place.
+
+        Returns the stacked device output [4, B]: rows are (allowed,
+        remaining, reset_after, retry_after) — fetch with one np.asarray.
+        """
+        assert len(slots) <= self.SCRATCH, "batch exceeds scratch region"
+        self.state, out = gcra_batch(
+            self.state,
+            jnp.asarray(slots, jnp.int32),
+            jnp.asarray(rank, jnp.int32),
+            jnp.asarray(is_last, bool),
+            jnp.asarray(emission, jnp.int64),
+            jnp.asarray(tolerance, jnp.int64),
+            jnp.asarray(quantity, jnp.int64),
+            jnp.asarray(valid, bool),
+            now_ns,
+            with_degen=with_degen,
+            compact=compact,
+        )
+        return out
+
+    def check_many(
+        self,
+        slots: np.ndarray,
+        rank: np.ndarray,
+        is_last: np.ndarray,
+        emission: np.ndarray,
+        tolerance: np.ndarray,
+        quantity: np.ndarray,
+        valid: np.ndarray,
+        now_ns: np.ndarray,
+        with_degen: bool = True,
+        compact: bool = False,
+    ) -> jax.Array:
+        """K stacked micro-batches ([K, B] inputs, i64[K] timestamps) in one
+        launch; returns the [K, 4, B] stacked device output."""
+        assert slots.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
+        self.state, out = gcra_scan(
+            self.state,
+            jnp.asarray(slots, jnp.int32),
+            jnp.asarray(rank, jnp.int32),
+            jnp.asarray(is_last, bool),
+            jnp.asarray(emission, jnp.int64),
+            jnp.asarray(tolerance, jnp.int64),
+            jnp.asarray(quantity, jnp.int64),
+            jnp.asarray(valid, bool),
+            jnp.asarray(now_ns, jnp.int64),
+            with_degen=with_degen,
+            compact=compact,
+        )
+        return out
+
+    def sweep(self, now_ns: int) -> np.ndarray:
+        """Vacate expired slots; returns the boolean expired mask (host)."""
+        self.state, expired = sweep_expired(now_ns, self.state, self.capacity)
+        return np.asarray(expired)
+
+    def grow(self, new_capacity: int) -> None:
+        """Double-style reallocation, like HashMap growth in the reference."""
+        if new_capacity <= self.capacity:
+            return
+        extra = self._alloc(new_capacity - self.capacity)
+        real = self.state[: self.capacity]
+        scratch = self.state[self.capacity :]
+        self.state = jnp.concatenate([real, extra[: new_capacity - self.capacity], scratch])
+        self.capacity = new_capacity
+
+    def live_count(self, now_ns: int) -> int:
+        """Number of live (non-expired) entries; diagnostic only."""
+        return int(jnp.sum(self.expiry > now_ns))
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
