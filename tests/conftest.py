@@ -8,12 +8,20 @@ separately on the real chip via bench.py.
 
 import os
 
-# Must be set before jax initialises its backends.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Force the CPU platform via jax.config (not the env var: accelerator PJRT
+# plugins loaded from sitecustomize can re-point JAX_PLATFORMS at real
+# hardware after the environment is read).  Set THROTTLECRAB_TPU_TEST_REAL=1
+# to run the suite on whatever backend the environment provides instead.
 import throttlecrab_tpu  # noqa: E402,F401  (enables x64 before any tracing)
+
+if not os.environ.get("THROTTLECRAB_TPU_TEST_REAL"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

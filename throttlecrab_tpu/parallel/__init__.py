@@ -1,0 +1,13 @@
+"""Multi-device sharding of the GCRA bucket table.
+
+The reference scales horizontally only by client-side key sharding
+(`README.md:247-249`); here key-shard data parallelism is first-class: the
+bucket table is sharded over a `jax.sharding.Mesh` axis, keys route to
+shards by a stable hash on the host, and each device decides its shard's
+requests with the same batched kernel — one `shard_map`-ped launch for the
+whole mesh, with `psum`-reduced allowed/denied counters riding the ICI.
+"""
+
+from .sharded import ShardedBucketTable, ShardedTpuRateLimiter, shard_of_key
+
+__all__ = ["ShardedBucketTable", "ShardedTpuRateLimiter", "shard_of_key"]

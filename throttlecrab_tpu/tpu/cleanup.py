@@ -19,8 +19,9 @@ whole operation-count range at once.
 
 from __future__ import annotations
 
-from math import gcd
 from typing import Optional
+
+import numpy as np
 
 from ..core.i64 import NS_PER_SEC
 
@@ -68,25 +69,28 @@ class PeriodicPolicy(CleanupPolicy):
 class ProbabilisticPolicy(CleanupPolicy):
     """Deterministic sampled sweeps (probabilistic.rs:110-125).
 
-    The per-op rule fires when `ops * 2654435761 % p == 0`, i.e. when ops is
-    a multiple of g = p / gcd(2654435761, p); over a batch of n ops the
-    policy fires iff the range (prev, prev + n] contains such a multiple.
+    The per-op rule fires when `(ops * 2654435761 mod 2^64) % p == 0`; over a
+    batch of n ops the policy fires iff any op count in (prev, prev + n]
+    satisfies it — checked exactly with a vectorized wrapping multiply (the
+    u64 wrap makes the rule aperiodic past ops ≈ 6.9e9, so no divisor
+    shortcut is valid).
     """
 
     def __init__(self, probability: int = PROBABILISTIC_CLEANUP_MODULO) -> None:
         self.probability = probability
-        # probability 0 never fires (Rust is_multiple_of(0) ⇔ hash == 0,
-        # unreachable for the odd-prime product).
-        self._g = (
-            probability // gcd(_PRIME, probability) if probability > 0 else 0
-        )
         self._ops = 0
         self._fire = False
 
     def record_ops(self, n):
         prev = self._ops
         self._ops += n
-        if self._g and self._ops // self._g > prev // self._g:
+        # probability 0 never fires (Rust is_multiple_of(0) ⇔ hash == 0,
+        # unreachable for the odd-prime product with ops < 2^64).
+        if self.probability <= 0 or self._fire or n <= 0:
+            return
+        ops = np.arange(prev + 1, prev + n + 1, dtype=np.uint64)
+        hashed = ops * np.uint64(_PRIME)  # wraps mod 2^64
+        if (hashed % np.uint64(self.probability) == 0).any():
             self._fire = True
 
     def should_clean(self, now_ns, live_keys, capacity):

@@ -84,6 +84,86 @@ class BatchResult:
     status: np.ndarray
 
 
+def prepare_batch(n, max_burst, count_per_period, period, quantity):
+    """Broadcast request params to length n, validate, derive GCRA params.
+
+    The shared prologue of every batch engine (single-device and sharded).
+    Returns (max_burst, quantity, emission, tolerance, status, valid).
+    """
+    max_burst = np.broadcast_to(np.asarray(max_burst, np.int64), (n,))
+    count_per_period = np.broadcast_to(
+        np.asarray(count_per_period, np.int64), (n,)
+    )
+    period = np.broadcast_to(np.asarray(period, np.int64), (n,))
+    quantity = np.broadcast_to(np.asarray(quantity, np.int64), (n,))
+
+    status = np.zeros(n, np.uint8)
+    emission, tolerance, invalid = derive_params(
+        max_burst, count_per_period, period
+    )
+    status[invalid] = STATUS_INVALID_PARAMS
+    status[quantity < 0] = STATUS_NEGATIVE_QUANTITY
+    valid = status == STATUS_OK
+    return max_burst, quantity, emission, tolerance, status, valid
+
+
+def param_rounds(rounds, slots, positions, emission, tolerance, quantity):
+    """Assign arrival-order param-run rounds into `rounds` at `positions`.
+
+    Round r holds each key's r-th maximal run of identical (emission,
+    tolerance, quantity), so processing rounds in order reproduces the
+    reference's sequential per-request semantics when a key's parameters
+    change mid-batch.
+    """
+    state: dict = {}
+    for i in positions:
+        sl = int(slots[i])
+        p = (int(emission[i]), int(tolerance[i]), int(quantity[i]))
+        st = state.get(sl)
+        if st is None:
+            state[sl] = [p, 0]
+        elif st[0] == p:
+            rounds[i] = st[1]
+        else:
+            st[0] = p
+            st[1] += 1
+            rounds[i] = st[1]
+    return rounds
+
+
+class ScalarCompatMixin:
+    """Scalar `rate_limit` (the reference library API) over a batch engine.
+
+    Mirrors `RateLimiter::rate_limit` (`rate_limiter.rs:102-117`): raising
+    validation errors, applying the pre-epoch clock-skew fallback, and
+    unpacking the single-request batch result.
+    """
+
+    def rate_limit(
+        self,
+        key,
+        max_burst: int,
+        count_per_period: int,
+        period: int,
+        quantity: int,
+        now_ns: int,
+    ):
+        if quantity < 0:
+            raise NegativeQuantity(quantity)
+        if max_burst <= 0 or count_per_period <= 0 or period <= 0:
+            raise InvalidRateLimit()
+        now_ns = normalize_now_ns(now_ns, period)
+        res = self.rate_limit_batch(
+            [key], [max_burst], [count_per_period], [period], [quantity], now_ns
+        )
+        return bool(res.allowed[0]), RateLimitResult(
+            limit=int(res.limit[0]),
+            remaining=int(res.remaining[0]),
+            reset_after_ns=int(res.reset_after_ns[0]),
+            retry_after_ns=int(res.retry_after_ns[0]),
+        )
+
+
 def derive_params(max_burst, count_per_period, period):
     """(emission_ns, tolerance_ns, invalid) via the reference f64 pipeline.
 
@@ -110,7 +190,7 @@ def derive_params(max_burst, count_per_period, period):
     return emission, tolerance, invalid
 
 
-class TpuRateLimiter:
+class TpuRateLimiter(ScalarCompatMixin):
     """Batched GCRA over a device bucket table + host keymap."""
 
     MIN_PAD = 16
@@ -163,20 +243,9 @@ class TpuRateLimiter:
         n = len(keys)
         if getattr(self.keymap, "BYTES_KEYS", False):
             keys = [k.encode() if isinstance(k, str) else k for k in keys]
-        max_burst = np.broadcast_to(np.asarray(max_burst, np.int64), (n,))
-        count_per_period = np.broadcast_to(
-            np.asarray(count_per_period, np.int64), (n,)
+        max_burst, quantity, emission, tolerance, status, valid = (
+            prepare_batch(n, max_burst, count_per_period, period, quantity)
         )
-        period = np.broadcast_to(np.asarray(period, np.int64), (n,))
-        quantity = np.broadcast_to(np.asarray(quantity, np.int64), (n,))
-
-        status = np.zeros(n, np.uint8)
-        emission, tolerance, invalid = derive_params(
-            max_burst, count_per_period, period
-        )
-        status[invalid] = STATUS_INVALID_PARAMS
-        status[quantity < 0] = STATUS_NEGATIVE_QUANTITY
-        valid = status == STATUS_OK
 
         slots, rank0, is_last0, n_full = self.keymap.resolve(keys, valid)
         while n_full:
@@ -244,33 +313,6 @@ class TpuRateLimiter:
 
     # ------------------------------------------------------------------ #
 
-    def rate_limit(
-        self,
-        key,
-        max_burst: int,
-        count_per_period: int,
-        period: int,
-        quantity: int,
-        now_ns: int,
-    ):
-        """Scalar-compat API mirroring core.RateLimiter.rate_limit."""
-        if quantity < 0:
-            raise NegativeQuantity(quantity)
-        if max_burst <= 0 or count_per_period <= 0 or period <= 0:
-            raise InvalidRateLimit()
-        now_ns = normalize_now_ns(now_ns, period)
-        res = self.rate_limit_batch(
-            [key], [max_burst], [count_per_period], [period], [quantity], now_ns
-        )
-        return bool(res.allowed[0]), RateLimitResult(
-            limit=int(res.limit[0]),
-            remaining=int(res.remaining[0]),
-            reset_after_ns=int(res.reset_after_ns[0]),
-            retry_after_ns=int(res.retry_after_ns[0]),
-        )
-
-    # ------------------------------------------------------------------ #
-
     def sweep(self, now_ns: int) -> int:
         """Run a cleanup sweep; returns the number of slots freed."""
         expired = self.table.sweep(now_ns)
@@ -306,18 +348,6 @@ class TpuRateLimiter:
         )
         if not conflict.any():
             return rounds
-
-        state: dict = {}
-        for i in np.flatnonzero(valid):
-            sl = int(slots[i])
-            p = (int(emission[i]), int(tolerance[i]), int(quantity[i]))
-            st = state.get(sl)
-            if st is None:
-                state[sl] = [p, 0]
-            elif st[0] == p:
-                rounds[i] = st[1]
-            else:
-                st[0] = p
-                st[1] += 1
-                rounds[i] = st[1]
-        return rounds
+        return param_rounds(
+            rounds, slots, np.flatnonzero(valid), emission, tolerance, quantity
+        )
