@@ -1,0 +1,191 @@
+"""RESP parser/serializer tests, including the reference's security suite.
+
+Ports `transport/redis/resp.rs` unit tests and the attack vectors from
+`transport/redis_security_test.rs:8-165`: huge/negative bulk and array
+sizes, deep nesting vs the depth cap, i64-overflow casts, NUL bytes,
+invalid UTF-8, and incremental/partial-frame parsing.
+"""
+
+import pytest
+
+from throttlecrab_tpu.server.resp import (
+    MAX_ARRAY_DEPTH,
+    Array,
+    BulkString,
+    Error,
+    Integer,
+    RespError,
+    RespParser,
+    SimpleString,
+    serialize,
+)
+
+
+def parse_one(data: bytes):
+    return RespParser().parse(data)
+
+
+# ---------------------------------------------------------------- basics #
+
+
+def test_parse_simple_string():
+    value, consumed = parse_one(b"+OK\r\n")
+    assert value == SimpleString("OK")
+    assert consumed == 5
+
+
+def test_parse_error():
+    value, consumed = parse_one(b"-ERR bad\r\n")
+    assert value == Error("ERR bad")
+    assert consumed == 10
+
+
+def test_parse_integer():
+    value, _ = parse_one(b":42\r\n")
+    assert value == Integer(42)
+    value, _ = parse_one(b":-7\r\n")
+    assert value == Integer(-7)
+
+
+def test_parse_bulk_string():
+    value, consumed = parse_one(b"$6\r\nfoobar\r\n")
+    assert value == BulkString("foobar")
+    assert consumed == 12
+
+
+def test_parse_null_bulk_string():
+    value, _ = parse_one(b"$-1\r\n")
+    assert value == BulkString(None)
+
+
+def test_parse_empty_bulk_string():
+    value, _ = parse_one(b"$0\r\n\r\n")
+    assert value == BulkString("")
+
+
+def test_parse_array():
+    value, consumed = parse_one(b"*2\r\n$3\r\nfoo\r\n$3\r\nbar\r\n")
+    assert value == Array((BulkString("foo"), BulkString("bar")))
+    assert consumed == 22
+
+
+def test_parse_null_array():
+    value, _ = parse_one(b"*-1\r\n")
+    assert value == Array(())
+
+
+def test_incomplete_frames_return_none():
+    assert parse_one(b"") is None
+    assert parse_one(b"+OK") is None
+    assert parse_one(b"$6\r\nfoo") is None
+    assert parse_one(b"*2\r\n$3\r\nfoo\r\n") is None
+    assert parse_one(b"*2\r\n$3\r\nfoo\r\n$3\r\nba") is None
+
+
+def test_incremental_parse_across_chunks():
+    # The connection loop accumulates; the parser must eventually accept.
+    frame = b"*2\r\n$4\r\nPING\r\n$5\r\nhello\r\n"
+    for cut in range(len(frame)):
+        partial = frame[:cut]
+        assert RespParser().parse(partial) is None
+    value, consumed = RespParser().parse(frame)
+    assert value == Array((BulkString("PING"), BulkString("hello")))
+    assert consumed == len(frame)
+
+
+def test_pipelined_commands_consume_exactly_one():
+    data = b"+A\r\n+B\r\n"
+    value, consumed = parse_one(data)
+    assert value == SimpleString("A")
+    value2, _ = parse_one(data[consumed:])
+    assert value2 == SimpleString("B")
+
+
+# ------------------------------------------------------------- security #
+
+
+def test_huge_bulk_string_length_rejected():
+    with pytest.raises(RespError):
+        parse_one(b"$999999999999\r\n")
+
+
+def test_negative_bulk_string_length_rejected():
+    with pytest.raises(RespError):
+        parse_one(b"$-2\r\n")
+
+
+def test_huge_array_size_rejected():
+    with pytest.raises(RespError):
+        parse_one(b"*999999999999\r\n")
+
+
+def test_negative_array_size_rejected():
+    with pytest.raises(RespError):
+        parse_one(b"*-2\r\n")
+
+
+def test_i64_overflow_length_rejected():
+    with pytest.raises(RespError):
+        parse_one(b"$92233720368547758070\r\n")
+
+
+def test_depth_cap_blocks_deep_nesting():
+    # 200 nested arrays vs the depth-128 cap (redis_security_test.rs).
+    data = b"*1\r\n" * 200 + b":1\r\n"
+    with pytest.raises(RespError):
+        parse_one(data)
+
+
+def test_depth_under_cap_parses():
+    depth = MAX_ARRAY_DEPTH - 1
+    data = b"*1\r\n" * depth + b":1\r\n"
+    value, _ = parse_one(data)
+    for _ in range(depth):
+        assert isinstance(value, Array) and len(value.value) == 1
+        value = value.value[0]
+    assert value == Integer(1)
+
+
+def test_invalid_type_marker_rejected():
+    with pytest.raises(RespError):
+        parse_one(b"!bad\r\n")
+
+
+def test_invalid_utf8_rejected():
+    with pytest.raises(RespError):
+        parse_one(b"$2\r\n\xff\xfe\r\n")
+
+
+def test_nul_bytes_in_bulk_string_survive():
+    value, _ = parse_one(b"$3\r\na\x00b\r\n")
+    assert value == BulkString("a\x00b")
+
+
+def test_non_numeric_length_rejected():
+    with pytest.raises(RespError):
+        parse_one(b"$abc\r\n")
+    with pytest.raises(RespError):
+        parse_one(b":12x\r\n")
+
+
+# ----------------------------------------------------------- serializer #
+
+
+def test_serialize_round_trip():
+    for value in (
+        SimpleString("OK"),
+        Error("ERR x"),
+        Integer(-123),
+        BulkString("hello"),
+        BulkString(None),
+        Array((Integer(1), BulkString("a"), Array((Integer(2),)))),
+    ):
+        data = serialize(value)
+        parsed, consumed = parse_one(data)
+        assert parsed == value
+        assert consumed == len(data)
+
+
+def test_serialize_throttle_response_shape():
+    resp = Array(tuple(Integer(n) for n in (1, 10, 9, 60, 0)))
+    assert serialize(resp) == b"*5\r\n:1\r\n:10\r\n:9\r\n:60\r\n:0\r\n"

@@ -1,0 +1,195 @@
+"""Micro-batching engine tests.
+
+The engine is the actor replacement: these mirror the reference's actor
+tests (`actor_tests.rs:33-70` — N concurrent hits on a burst-B key allow
+exactly B) plus batching-specific behavior (coalescing, linger flush,
+per-request validation errors, cleanup policy integration).  The limiter
+underneath is the real TPU engine on the virtual-CPU backend.
+"""
+
+import asyncio
+
+import pytest
+
+from throttlecrab_tpu.server.engine import BatchingEngine, ThrottleError
+from throttlecrab_tpu.server.metrics import Metrics
+from throttlecrab_tpu.server.types import ThrottleRequest
+from throttlecrab_tpu.tpu.cleanup import PeriodicPolicy
+from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+NS = 1_000_000_000
+T0 = 1_700_000_000 * NS
+
+
+class VirtualClock:
+    def __init__(self, start_ns=T0):
+        self.now = start_ns
+
+    def __call__(self):
+        return self.now
+
+
+def make_engine(**kwargs):
+    clock = kwargs.pop("clock", VirtualClock())
+    limiter = TpuRateLimiter(capacity=1024)
+    engine = BatchingEngine(limiter, now_fn=clock, **kwargs)
+    return engine, clock
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def req(key="k", burst=10, count=100, period=60, quantity=1):
+    return ThrottleRequest(key, burst, count, period, quantity)
+
+
+def test_actor_invariant_exactly_burst_allowed():
+    """actor_tests.rs:33-70: 20 concurrent requests, burst 10 → 10 allowed."""
+
+    async def main():
+        engine, _ = make_engine(batch_size=64, max_linger_us=1000)
+        results = await asyncio.gather(
+            *[engine.throttle(req(burst=10, period=3600)) for _ in range(20)]
+        )
+        return [r.allowed for r in results]
+
+    allowed = run(main())
+    assert sum(allowed) == 10
+    # Arrival order: the first 10 get through.
+    assert all(allowed[:10]) and not any(allowed[10:])
+
+
+def test_full_batch_flushes_without_linger():
+    async def main():
+        engine, _ = make_engine(batch_size=4, max_linger_us=10_000_000)
+        results = await asyncio.wait_for(
+            asyncio.gather(
+                *[engine.throttle(req(key=f"k{i}")) for i in range(4)]
+            ),
+            timeout=2.0,
+        )
+        return results
+
+    results = run(main())
+    assert all(r.allowed for r in results)
+
+
+def test_linger_flushes_partial_batch():
+    async def main():
+        engine, _ = make_engine(batch_size=4096, max_linger_us=5_000)
+        return await asyncio.wait_for(engine.throttle(req()), timeout=2.0)
+
+    response = run(main())
+    assert response.allowed
+    assert response.limit == 10
+
+
+def test_validation_error_is_per_request():
+    async def main():
+        engine, _ = make_engine(batch_size=3, max_linger_us=1000)
+        good1 = engine.throttle(req(key="a"))
+        bad = engine.throttle(req(key="b", burst=-1))
+        good2 = engine.throttle(req(key="c"))
+        results = await asyncio.gather(good1, bad, good2, return_exceptions=True)
+        return results
+
+    r1, r2, r3 = run(main())
+    assert r1.allowed
+    assert isinstance(r2, ThrottleError)
+    assert r3.allowed
+
+
+def test_negative_quantity_error_message():
+    async def main():
+        engine, _ = make_engine(batch_size=1)
+        try:
+            await engine.throttle(req(quantity=-1))
+        except ThrottleError as e:
+            return str(e)
+
+    assert "negative" in run(main())
+
+
+def test_seconds_truncation_at_type_boundary():
+    """types.rs:87-97: durations are whole seconds on the wire."""
+
+    async def main():
+        engine, _ = make_engine(batch_size=1)
+        # burst 2 @ 3/s → emission ~333ms; third hit denied with
+        # retry_after ≈ 333ms, which truncates to 0 whole seconds.
+        r = None
+        for _ in range(3):
+            r = await engine.throttle(req(key="t", burst=2, count=3, period=1))
+        return r
+
+    response = run(main())
+    assert not response.allowed
+    assert response.retry_after == 0  # 333ms truncates to 0 whole seconds
+
+
+def test_metrics_launch_accounting():
+    async def main():
+        metrics = Metrics()
+        limiter = TpuRateLimiter(capacity=256)
+        engine = BatchingEngine(
+            limiter, batch_size=8, max_linger_us=1000,
+            metrics=metrics, now_fn=VirtualClock(),
+        )
+        await asyncio.gather(
+            *[engine.throttle(req(key=f"m{i}")) for i in range(8)]
+        )
+        return metrics
+
+    metrics = run(main())
+    assert metrics.device_launches >= 1
+    assert metrics.batched_requests == 8
+    assert metrics.max_batch <= 8
+
+
+def test_cleanup_policy_sweeps_between_batches():
+    async def main():
+        clock = VirtualClock()
+        policy = PeriodicPolicy(interval_ns=60 * NS)
+        limiter = TpuRateLimiter(capacity=256)
+        engine = BatchingEngine(
+            limiter, batch_size=1, cleanup_policy=policy, now_fn=clock,
+        )
+        # period 1s → TTL ~1s; expire it, then advance past the interval.
+        await engine.throttle(req(key="x", burst=1, count=1, period=1))
+        assert len(limiter) == 1
+        clock.now += 120 * NS
+        await engine.throttle(req(key="y"))  # arms the policy clock
+        clock.now += 120 * NS
+        await engine.throttle(req(key="z"))  # fires the sweep
+        return limiter
+
+    limiter = run(main())
+    assert len(limiter) <= 2  # "x" (and possibly "y") swept
+
+
+def test_shutdown_flushes_then_refuses():
+    async def main():
+        engine, _ = make_engine(batch_size=4096, max_linger_us=10_000_000)
+        pending = asyncio.ensure_future(engine.throttle(req(key="p")))
+        await asyncio.sleep(0)  # request lands in the pending list
+        await engine.shutdown()
+        result = await pending
+        with pytest.raises(ThrottleError):
+            await engine.throttle(req(key="q"))
+        return result
+
+    assert run(main()).allowed
+
+
+def test_oversized_wave_splits_into_batches():
+    async def main():
+        engine, _ = make_engine(batch_size=16, max_linger_us=1000)
+        results = await asyncio.gather(
+            *[engine.throttle(req(key=f"w{i % 5}", burst=50, period=3600))
+              for i in range(100)]
+        )
+        return results
+
+    results = run(main())
+    assert all(r.allowed for r in results)  # 20 per key < burst 50

@@ -1,0 +1,365 @@
+"""In-process transport tests over real sockets.
+
+The reference tests its transports against real listeners without external
+processes (`grpc.rs:196-296`, `transport/redis_test.rs`); same here: each
+test boots the transport on an ephemeral port, drives it with a raw client,
+and asserts wire-level behavior — shared limiter state across transports
+included (`tests/integration/multi_transport.rs:159-225`).
+"""
+
+import asyncio
+import json
+
+from throttlecrab_tpu.server.engine import BatchingEngine
+from throttlecrab_tpu.server.http import HttpTransport
+from throttlecrab_tpu.server.metrics import Metrics
+from throttlecrab_tpu.server.redis import RedisTransport
+from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+T0 = 1_700_000_000 * 1_000_000_000
+
+
+def make_stack(**engine_kwargs):
+    metrics = Metrics(max_denied_keys=10)
+    limiter = TpuRateLimiter(capacity=1024)
+    engine = BatchingEngine(
+        limiter,
+        batch_size=engine_kwargs.pop("batch_size", 64),
+        max_linger_us=engine_kwargs.pop("max_linger_us", 500),
+        now_fn=lambda: T0,
+        **engine_kwargs,
+    )
+    return engine, metrics
+
+
+async def http_request(port, method, path, body=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    payload = json.dumps(body).encode() if body is not None else b""
+    head = (
+        f"{method} {path} HTTP/1.1\r\n"
+        "Host: localhost\r\n"
+        f"Content-Length: {len(payload)}\r\n"
+        "Connection: close\r\n\r\n"
+    ).encode()
+    writer.write(head + payload)
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    await writer.wait_closed()
+    head_raw, _, body_raw = raw.partition(b"\r\n\r\n")
+    status = int(head_raw.split(b" ", 2)[1])
+    return status, body_raw
+
+
+async def resp_command(reader, writer, *parts):
+    frame = b"*%d\r\n" % len(parts)
+    for part in parts:
+        data = part.encode() if isinstance(part, str) else part
+        frame += b"$%d\r\n%s\r\n" % (len(data), data)
+    writer.write(frame)
+    await writer.drain()
+    return await asyncio.wait_for(reader.read(4096), timeout=2.0)
+
+
+# ------------------------------------------------------------------ HTTP #
+
+
+def test_http_throttle_health_metrics():
+    async def main():
+        engine, metrics = make_stack()
+        transport = HttpTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+
+        body = {"key": "u:1", "max_burst": 3, "count_per_period": 10,
+                "period": 60}
+        allowed = []
+        for _ in range(5):
+            status, raw = await http_request(port, "POST", "/throttle", body)
+            assert status == 200
+            allowed.append(json.loads(raw)["allowed"])
+
+        status, raw = await http_request(port, "GET", "/health")
+        assert (status, raw) == (200, b"OK")
+
+        status, raw = await http_request(port, "GET", "/metrics")
+        assert status == 200
+        text = raw.decode()
+        assert "throttlecrab_requests_total 5" in text
+        assert 'transport="http"} 5' in text
+        assert "throttlecrab_requests_allowed 3" in text
+        assert "throttlecrab_requests_denied 2" in text
+        assert 'throttlecrab_top_denied_keys{key="u:1",rank="1"} 2' in text
+
+        await transport.stop()
+        return allowed
+
+    allowed = asyncio.run(main())
+    assert allowed == [True, True, True, False, False]
+
+
+def test_http_error_shapes():
+    async def main():
+        engine, metrics = make_stack()
+        transport = HttpTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+
+        # Malformed JSON → 400 with error payload.
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        bad = b"not json"
+        writer.write(
+            b"POST /throttle HTTP/1.1\r\nHost: x\r\nContent-Length: "
+            + str(len(bad)).encode() + b"\r\nConnection: close\r\n\r\n" + bad
+        )
+        await writer.drain()
+        raw = await reader.read(-1)
+        writer.close()
+        assert b" 400 " in raw.split(b"\r\n", 1)[0]
+        assert b"error" in raw
+
+        # Invalid params → 500 (engine-level error, like the reference).
+        status, raw = await http_request(
+            port, "POST", "/throttle",
+            {"key": "k", "max_burst": -1, "count_per_period": 10,
+             "period": 60},
+        )
+        assert status == 500
+        assert b"invalid rate limit parameters" in raw
+
+        # Unknown route → 404.
+        status, _ = await http_request(port, "GET", "/nope")
+        assert status == 404
+
+        await transport.stop()
+
+    asyncio.run(main())
+
+
+def test_http_quantity_defaults_to_one():
+    async def main():
+        engine, metrics = make_stack()
+        transport = HttpTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+        body = {"key": "q", "max_burst": 10, "count_per_period": 100,
+                "period": 60}
+        _, raw = await http_request(port, "POST", "/throttle", body)
+        first = json.loads(raw)
+        await transport.stop()
+        return first
+
+    first = asyncio.run(main())
+    assert first["allowed"] is True
+    assert first["remaining"] == 9  # one token consumed
+
+
+def test_http_keep_alive_pipelining():
+    async def main():
+        engine, metrics = make_stack()
+        transport = HttpTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        body = json.dumps({"key": "ka", "max_burst": 10,
+                           "count_per_period": 100, "period": 60}).encode()
+        one = (
+            b"POST /throttle HTTP/1.1\r\nHost: x\r\nContent-Length: "
+            + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+        writer.write(one + one)  # two requests, one connection
+        await writer.drain()
+        data = b""
+        while data.count(b"HTTP/1.1 200") < 2:
+            chunk = await asyncio.wait_for(reader.read(4096), timeout=2.0)
+            if not chunk:
+                break
+            data += chunk
+        writer.close()
+        await transport.stop()
+        return data
+
+    data = asyncio.run(main())
+    assert data.count(b"HTTP/1.1 200") == 2
+
+
+# ----------------------------------------------------------------- Redis #
+
+
+def test_redis_ping_throttle_quit():
+    async def main():
+        engine, metrics = make_stack()
+        transport = RedisTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+
+        assert await resp_command(reader, writer, "PING") == b"+PONG\r\n"
+        assert await resp_command(reader, writer, "PING", "hi") == (
+            b"$2\r\nhi\r\n"
+        )
+        # Case-insensitive commands (redis/mod.rs:166).
+        # burst 3 @ 10/60s: emission 6s, tolerance 12s → first hit leaves
+        # remaining=2, reset_after=12s.
+        out = await resp_command(reader, writer, "throttle", "rk", "3",
+                                 "10", "60")
+        assert out == b"*5\r\n:1\r\n:3\r\n:2\r\n:12\r\n:0\r\n"
+        for _ in range(2):
+            out = await resp_command(reader, writer, "THROTTLE", "rk", "3",
+                                     "10", "60")
+        assert out.startswith(b"*5\r\n:1\r\n")
+        out = await resp_command(reader, writer, "THROTTLE", "rk", "3",
+                                 "10", "60")
+        assert out.startswith(b"*5\r\n:0\r\n")  # burst exhausted
+
+        assert await resp_command(reader, writer, "QUIT") == b"+OK\r\n"
+        assert await reader.read(16) == b""  # server closed
+
+        await transport.stop()
+        return metrics
+
+    metrics = asyncio.run(main())
+    assert metrics.requests_total == 4
+    assert metrics.requests_denied == 1
+
+
+def test_redis_error_cases():
+    async def main():
+        engine, metrics = make_stack()
+        transport = RedisTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+
+        out = await resp_command(reader, writer, "NOSUCH")
+        assert out == b"-ERR unknown command 'NOSUCH'\r\n"
+        out = await resp_command(reader, writer, "THROTTLE", "k")
+        assert b"wrong number of arguments" in out
+        out = await resp_command(reader, writer, "THROTTLE", "k", "abc",
+                                 "10", "60")
+        assert out == b"-ERR invalid max_burst\r\n"
+        # Quantity argument works: burst 10 @ 100/60s, qty 5 → remaining 5,
+        # reset_after 7.8s truncated to 7.
+        out = await resp_command(reader, writer, "THROTTLE", "qk", "10",
+                                 "100", "60", "5")
+        assert out == b"*5\r\n:1\r\n:10\r\n:5\r\n:7\r\n:0\r\n"
+        writer.close()
+        await transport.stop()
+
+    asyncio.run(main())
+
+
+def test_redis_partial_frames_accumulate():
+    async def main():
+        engine, metrics = make_stack()
+        transport = RedisTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        frame = b"*1\r\n$4\r\nPING\r\n"
+        writer.write(frame[:5])
+        await writer.drain()
+        await asyncio.sleep(0.05)
+        writer.write(frame[5:])
+        await writer.drain()
+        out = await asyncio.wait_for(reader.read(64), timeout=2.0)
+        writer.close()
+        await transport.stop()
+        return out
+
+    assert asyncio.run(main()) == b"+PONG\r\n"
+
+
+def test_redis_malformed_input_closes_with_error():
+    async def main():
+        engine, metrics = make_stack()
+        transport = RedisTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"*999999999999\r\n")
+        await writer.drain()
+        out = await asyncio.wait_for(reader.read(256), timeout=2.0)
+        writer.close()
+        await transport.stop()
+        return out
+
+    assert asyncio.run(main()).startswith(b"-ERR")
+
+
+# ------------------------------------------------------------------ gRPC #
+
+
+def test_grpc_throttle_roundtrip():
+    import grpc.aio
+
+    from throttlecrab_tpu.server.grpc import GrpcTransport
+    from throttlecrab_tpu.server.proto import throttlecrab_pb2 as pb
+
+    async def main():
+        engine, metrics = make_stack()
+        transport = GrpcTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            method = channel.unary_unary(
+                "/throttlecrab.RateLimiter/Throttle",
+                request_serializer=pb.ThrottleRequest.SerializeToString,
+                response_deserializer=pb.ThrottleResponse.FromString,
+            )
+            results = []
+            for _ in range(5):
+                response = await method(
+                    pb.ThrottleRequest(
+                        key="g:1", max_burst=3, count_per_period=10,
+                        period=60, quantity=1,
+                    )
+                )
+                results.append(response.allowed)
+            last = response
+        await transport.stop()
+        return results, last, metrics
+
+    results, last, metrics = asyncio.run(main())
+    assert results == [True, True, True, False, False]
+    assert last.limit == 3
+    assert last.retry_after >= 1
+    assert metrics.requests_by_transport["grpc"] == 5
+
+
+# ------------------------------------- shared state across transports #
+
+
+def test_multi_transport_shared_limits():
+    """One key, limits shared across HTTP and Redis
+    (multi_transport.rs:159-225)."""
+
+    async def main():
+        engine, metrics = make_stack()
+        http_t = HttpTransport("127.0.0.1", 0, engine, metrics)
+        redis_t = RedisTransport("127.0.0.1", 0, engine, metrics)
+        await http_t.start()
+        await redis_t.start()
+
+        body = {"key": "shared", "max_burst": 4, "count_per_period": 10,
+                "period": 60}
+        seq = []
+        for _ in range(2):
+            _, raw = await http_request(
+                http_t.bound_port, "POST", "/throttle", body
+            )
+            seq.append(json.loads(raw)["allowed"])
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", redis_t.bound_port
+        )
+        for _ in range(3):
+            out = await resp_command(reader, writer, "THROTTLE", "shared",
+                                     "4", "10", "60")
+            seq.append(out.startswith(b"*5\r\n:1\r\n"))
+        writer.close()
+        await http_t.stop()
+        await redis_t.stop()
+        return seq
+
+    assert asyncio.run(main()) == [True, True, True, True, False]

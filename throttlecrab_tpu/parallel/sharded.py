@@ -282,6 +282,11 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
     def __len__(self) -> int:
         return sum(len(km) for km in self.keymaps)
 
+    @property
+    def total_capacity(self) -> int:
+        """Global slot capacity across every shard (len() is also global)."""
+        return self.table.capacity * self.n_shards
+
     # ------------------------------------------------------------------ #
 
     def rate_limit_batch(

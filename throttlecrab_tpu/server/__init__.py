@@ -1,0 +1,23 @@
+"""throttlecrab-tpu server: micro-batching front-end + wire transports.
+
+The TPU-native re-design of `throttlecrab-server`: where the reference funnels
+every transport's requests through one mpsc channel into a single-threaded
+actor (`actor.rs:102-236`), this server coalesces them into fixed-size
+batches and decides thousands per device launch (engine.py).  The wire
+surface is identical: HTTP/JSON, gRPC, and Redis/RESP speaking the reference
+schemas, shared state across all three, server-side timestamps, Prometheus
+metrics and `THROTTLECRAB_*` configuration.
+"""
+
+from .config import Config
+from .engine import BatchingEngine
+from .metrics import Metrics
+from .types import ThrottleRequest, ThrottleResponse
+
+__all__ = [
+    "BatchingEngine",
+    "Config",
+    "Metrics",
+    "ThrottleRequest",
+    "ThrottleResponse",
+]

@@ -1,0 +1,141 @@
+"""Server entry point: `python -m throttlecrab_tpu.server --http ...`.
+
+Lifecycle mirrors the reference's `main.rs:49-184`: parse config → init
+logging → build metrics → build limiter + micro-batching engine (the actor
+replacement) → start every enabled transport → wait for SIGINT/SIGTERM →
+graceful shutdown (flush the engine, stop transports).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import sys
+
+from .config import Config, ConfigError
+from .engine import BatchingEngine
+from .metrics import Metrics
+from .store import create_cleanup_policy, create_limiter
+
+log = logging.getLogger("throttlecrab")
+
+LOG_LEVELS = {
+    "error": logging.ERROR,
+    "warn": logging.WARNING,
+    "info": logging.INFO,
+    "debug": logging.DEBUG,
+    "trace": logging.DEBUG,
+}
+
+
+def build_transports(config: Config, engine, metrics):
+    """One instance per enabled transport (main.rs:74-116)."""
+    transports = []
+    if config.http:
+        from .http import HttpTransport
+
+        transports.append(
+            HttpTransport(config.http_host, config.http_port, engine, metrics)
+        )
+    if config.grpc:
+        from .grpc import GrpcTransport
+
+        transports.append(
+            GrpcTransport(config.grpc_host, config.grpc_port, engine, metrics)
+        )
+    if config.redis:
+        from .redis import RedisTransport
+
+        transports.append(
+            RedisTransport(
+                config.redis_host, config.redis_port, engine, metrics
+            )
+        )
+    return transports
+
+
+async def run_server(config: Config) -> None:
+    metrics = (
+        Metrics.builder().max_denied_keys(config.max_denied_keys).build()
+    )
+    log.info("starting rate limiter with %s store", config.store)
+    limiter = create_limiter(config)
+    engine = BatchingEngine(
+        limiter,
+        batch_size=config.batch_size,
+        max_linger_us=config.max_linger_us,
+        cleanup_policy=create_cleanup_policy(config),
+        metrics=metrics,
+    )
+    transports = build_transports(config, engine, metrics)
+
+    for transport in transports:
+        await transport.start()
+
+    stop = asyncio.Event()
+
+    def _signal_handler() -> None:
+        log.info("shutdown signal received")
+        stop.set()
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, _signal_handler)
+        except NotImplementedError:  # pragma: no cover - non-unix
+            pass
+
+    serve_tasks = [
+        asyncio.create_task(t.serve_forever(), name=f"transport-{t.name}")
+        for t in transports
+    ]
+    stop_task = asyncio.create_task(stop.wait())
+    # A transport crashing ends the process with an error, like the
+    # reference's JoinSet select (main.rs:143-171).
+    done, _pending = await asyncio.wait(
+        serve_tasks + [stop_task], return_when=asyncio.FIRST_COMPLETED
+    )
+    failed = False
+    for task in done:
+        if task is not stop_task and task.exception() is not None:
+            log.error("transport failed: %r", task.exception())
+            failed = True
+
+    log.info("shutting down")
+    stop_task.cancel()
+    await engine.shutdown()
+    for transport in transports:
+        await transport.stop()
+    for task in serve_tasks:
+        task.cancel()
+    await asyncio.gather(*serve_tasks, stop_task, return_exceptions=True)
+    if failed:
+        raise TransportFailure("a transport task ended with an error")
+
+
+class TransportFailure(RuntimeError):
+    pass
+
+
+def main(argv=None) -> int:
+    try:
+        config = Config.from_env_and_args(argv)
+    except ConfigError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    logging.basicConfig(
+        level=LOG_LEVELS.get(config.log_level.lower(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    try:
+        asyncio.run(run_server(config))
+    except KeyboardInterrupt:
+        pass
+    except TransportFailure:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

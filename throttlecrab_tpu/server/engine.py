@@ -1,0 +1,193 @@
+"""Micro-batching engine: the TPU replacement for the reference's actor.
+
+The reference serializes every request through one mpsc channel into a
+single-threaded actor that decides them one at a time
+(`actor.rs:102-236`).  Here the same funnel point instead *coalesces*:
+requests from every transport append to a pending list with a future; a
+flush (triggered by the batch filling or a linger deadline) stamps the batch
+with one server-side timestamp, resolves keys, runs the batched device
+kernel, and completes every future.  The two tunables — `batch_size` and
+`max_linger_us` — are the throughput/latency knob pair that the actor's
+`buffer_size` becomes.
+
+Decisions execute on a worker thread (one at a time, preserving the actor's
+sequential-state guarantee) so the event loop keeps accepting requests while
+the device is busy — the host/device pipeline is the analog of the
+reference's transport-task/actor-task split.
+
+Cleanup runs between batches: the engine consults a `CleanupPolicy`
+(tpu/cleanup.py — periodic / probabilistic / adaptive, the reference's three
+store flavors) and triggers the expiry-compaction sweep on the device.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional, Tuple
+
+from ..tpu.cleanup import CleanupPolicy
+from ..tpu.limiter import (
+    STATUS_INVALID_PARAMS,
+    STATUS_NEGATIVE_QUANTITY,
+    STATUS_OK,
+)
+from .types import ThrottleRequest, ThrottleResponse
+
+STATUS_MESSAGES = {
+    STATUS_NEGATIVE_QUANTITY: "quantity cannot be negative",
+    STATUS_INVALID_PARAMS: "invalid rate limit parameters",
+}
+
+
+class ThrottleError(Exception):
+    """Per-request validation failure, mapped by each transport to its
+    protocol's error shape (the reference returns 500 JSON / gRPC
+    Status::internal / RESP -ERR)."""
+
+
+class BatchingEngine:
+    """Coalesces transport requests into device batches."""
+
+    def __init__(
+        self,
+        limiter,
+        batch_size: int = 4096,
+        max_linger_us: int = 200,
+        cleanup_policy: Optional[CleanupPolicy] = None,
+        metrics=None,
+        now_fn=None,
+    ) -> None:
+        """`limiter` is a TpuRateLimiter / ShardedTpuRateLimiter (or any
+        object with rate_limit_batch + sweep).  `now_fn` injects time for
+        tests (time is an input, never ambient — rate_limiter.rs:109)."""
+        import time
+
+        self.limiter = limiter
+        self.batch_size = batch_size
+        self.max_linger_s = max_linger_us / 1e6
+        self.cleanup_policy = cleanup_policy
+        self.metrics = metrics
+        self.now_fn = now_fn or time.time_ns
+        self._pending: List[
+            Tuple[ThrottleRequest, asyncio.Future]
+        ] = []
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._flush_lock = asyncio.Lock()
+        self._closed = False
+        # Strong refs: the event loop only weakly references tasks, and a
+        # GC'd flush task would strand its batch's futures forever.
+        self._flush_tasks: set = set()
+
+    # ------------------------------------------------------------------ #
+
+    async def throttle(self, request: ThrottleRequest) -> ThrottleResponse:
+        """Decide one request; resolves when its batch comes back."""
+        if self._closed:
+            raise ThrottleError("engine is shut down")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((request, fut))
+        if len(self._pending) == self.batch_size:
+            # Threshold crossing: one flush task drains everything pending,
+            # so later arrivals must not spawn redundant tasks.
+            self._schedule_flush(loop)
+        elif self._flush_handle is None:
+            self._flush_handle = loop.call_later(
+                self.max_linger_s, self._linger_fired, loop
+            )
+        return await fut
+
+    def _linger_fired(self, loop) -> None:
+        self._flush_handle = None
+        if self._pending:
+            self._schedule_flush(loop)
+
+    def _schedule_flush(self, loop) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        task = loop.create_task(self._flush())
+        self._flush_tasks.add(task)
+        task.add_done_callback(self._flush_tasks.discard)
+
+    async def _flush(self) -> None:
+        """Decide everything pending (in arrival order), batch by batch."""
+        async with self._flush_lock:
+            while self._pending:
+                batch = self._pending[: self.batch_size]
+                del self._pending[: len(batch)]
+                await self._decide(batch)
+
+    async def _decide(self, batch) -> None:
+        requests = [r for r, _ in batch]
+        futures = [f for _, f in batch]
+        now_ns = self.now_fn()
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None,
+                lambda: self.limiter.rate_limit_batch(
+                    [r.key for r in requests],
+                    [r.max_burst for r in requests],
+                    [r.count_per_period for r in requests],
+                    [r.period for r in requests],
+                    [r.quantity for r in requests],
+                    now_ns,
+                ),
+            )
+        except Exception as exc:  # internal failure fails the whole batch
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(ThrottleError(str(exc)))
+            return
+
+        if self.metrics is not None:
+            self.metrics.record_launch(len(batch))
+        for i, fut in enumerate(futures):
+            if fut.done():
+                continue
+            status = int(result.status[i])
+            if status != STATUS_OK:
+                fut.set_exception(
+                    ThrottleError(
+                        STATUS_MESSAGES.get(status, "internal error")
+                    )
+                )
+            else:
+                fut.set_result(
+                    ThrottleResponse.from_ns(
+                        allowed=bool(result.allowed[i]),
+                        limit=int(result.limit[i]),
+                        remaining=int(result.remaining[i]),
+                        reset_after_ns=int(result.reset_after_ns[i]),
+                        retry_after_ns=int(result.retry_after_ns[i]),
+                    )
+                )
+
+        await self._maybe_sweep(now_ns, len(batch))
+
+    # ------------------------------------------------------------------ #
+
+    async def _maybe_sweep(self, now_ns: int, n_ops: int) -> None:
+        policy = self.cleanup_policy
+        if policy is None:
+            return
+        policy.record_ops(n_ops)
+        live = len(self.limiter)
+        capacity = getattr(self.limiter, "total_capacity", 1 << 62)
+        if policy.should_clean(now_ns, live, capacity):
+            loop = asyncio.get_running_loop()
+            freed = await loop.run_in_executor(
+                None, self.limiter.sweep, now_ns
+            )
+            policy.after_sweep(now_ns, freed, live)
+            if self.metrics is not None:
+                self.metrics.record_sweep(freed)
+
+    async def shutdown(self) -> None:
+        """Flush outstanding requests and refuse new ones."""
+        self._closed = True
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        await self._flush()

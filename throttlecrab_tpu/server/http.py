@@ -1,0 +1,196 @@
+"""HTTP/JSON transport.
+
+Same wire surface as the reference's axum router (`http.rs:103-163`):
+`POST /throttle` with `{key, max_burst, count_per_period, period, quantity?}`
+(quantity defaults to 1, `http.rs:135`), `GET /health` returning "OK", and
+`GET /metrics` returning Prometheus text.  Timestamps are always server-side
+(`http.rs:127-128`); client-supplied timestamps are ignored by design.
+Errors return 500 with `{"error": ...}` like the reference's error handler
+(`http.rs:148-157`).
+
+Implemented directly on asyncio streams — a deliberately minimal HTTP/1.1
+(keep-alive, Content-Length bodies) server, the same spirit as the
+reference's hand-rolled RESP transport.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from .engine import BatchingEngine, ThrottleError
+from .metrics import Metrics
+from .types import ThrottleRequest
+
+log = logging.getLogger("throttlecrab.http")
+
+MAX_HEADER_BYTES = 16 * 1024
+MAX_BODY_BYTES = 1 << 20
+
+
+class HttpTransport:
+    """`POST /throttle` + `GET /health` + `GET /metrics`."""
+
+    name = "http"
+
+    def __init__(
+        self, host: str, port: int, engine: BatchingEngine, metrics: Metrics
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.engine = engine
+        self.metrics = metrics
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port
+        )
+        log.info("HTTP transport listening on %s:%d", self.host, self.port)
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def bound_port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    # ------------------------------------------------------------------ #
+
+    async def _handle_connection(self, reader, writer) -> None:
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    break
+                method, path, headers, body = request
+                keep_alive = (
+                    headers.get("connection", "keep-alive").lower()
+                    != "close"
+                )
+                status, payload, content_type = await self._route(
+                    method, path, body
+                )
+                await self._write_response(
+                    writer, status, payload, content_type, keep_alive
+                )
+                if not keep_alive:
+                    break
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+        ):
+            pass
+        except Exception:
+            log.exception("HTTP connection error")
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader):
+        """Parse one HTTP/1.1 request; None on clean EOF."""
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except asyncio.IncompleteReadError as e:
+            if not e.partial:
+                return None
+            raise
+        except asyncio.LimitOverrunError:
+            raise ValueError("header section too large")
+        if len(head) > MAX_HEADER_BYTES:
+            raise ValueError("header section too large")
+        lines = head.decode("latin-1").split("\r\n")
+        method, path, _version = lines[0].split(" ", 2)
+        headers = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", "0"))
+        if length > MAX_BODY_BYTES:
+            raise ValueError("body too large")
+        body = await reader.readexactly(length) if length else b""
+        return method, path, headers, body
+
+    async def _route(self, method: str, path: str, body: bytes):
+        if method == "POST" and path == "/throttle":
+            return await self._handle_throttle(body)
+        if method == "GET" and path == "/health":
+            return 200, b"OK", "text/plain"
+        if method == "GET" and path == "/metrics":
+            return (
+                200,
+                self.metrics.export_prometheus().encode(),
+                "text/plain; version=0.0.4",
+            )
+        return 404, b"Not Found", "text/plain"
+
+    async def _handle_throttle(self, body: bytes):
+        """http.rs:123-159 — server timestamp, quantity default 1."""
+        try:
+            data = json.loads(body)
+            request = ThrottleRequest(
+                key=str(data["key"]),
+                max_burst=int(data["max_burst"]),
+                count_per_period=int(data["count_per_period"]),
+                period=int(data["period"]),
+                quantity=int(data.get("quantity", 1)),
+            )
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            self.metrics.record_error(self.name)
+            return (
+                400,
+                json.dumps({"error": f"invalid request: {e}"}).encode(),
+                "application/json",
+            )
+        try:
+            response = await self.engine.throttle(request)
+        except ThrottleError as e:
+            self.metrics.record_error(self.name)
+            return (
+                500,
+                json.dumps({"error": str(e)}).encode(),
+                "application/json",
+            )
+        self.metrics.record_request_with_key(
+            self.name, response.allowed, request.key
+        )
+        payload = json.dumps(
+            {
+                "allowed": response.allowed,
+                "limit": response.limit,
+                "remaining": response.remaining,
+                "reset_after": response.reset_after,
+                "retry_after": response.retry_after,
+            }
+        ).encode()
+        return 200, payload, "application/json"
+
+    async def _write_response(
+        self, writer, status, payload, content_type, keep_alive
+    ) -> None:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  500: "Internal Server Error"}.get(status, "OK")
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n"
+            "\r\n"
+        ).encode("latin-1")
+        writer.write(head + payload)
+        await writer.drain()

@@ -1,0 +1,240 @@
+"""Server metrics with Prometheus text export.
+
+Same metric names and export format as the reference
+(`metrics.rs:233-310`), so dashboards port unchanged:
+`throttlecrab_uptime_seconds`, `throttlecrab_requests_total`,
+`throttlecrab_requests_by_transport{transport}`,
+`throttlecrab_requests_allowed`, `throttlecrab_requests_denied`,
+`throttlecrab_requests_errors`, `throttlecrab_top_denied_keys{key,rank}` —
+plus TPU-backend gauges (`throttlecrab_tpu_*`) for batch sizes and device
+launches, which the reference has no equivalent of.
+
+The reference guards its counters with atomics against transport threads
+(`metrics.rs:79-98`); here all mutation happens on the asyncio event-loop
+thread, so plain ints hold the same invariant (allowed + denied + errors ==
+total, tested like `metrics.rs:383-411`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+MAX_KEY_LENGTH = 256  # metrics.rs:21
+MAX_TRACKED_DENIED_KEYS = 10_000  # metrics.rs:119-121
+
+
+class TopDeniedKeys:
+    """Bounded denied-key counter (metrics.rs:24-76).
+
+    Grows to 3x max_keys, then sorts by count and truncates back — the
+    reference's amortized grow-then-prune strategy, kept verbatim including
+    the 256-byte key cap.
+    """
+
+    def __init__(self, max_keys: int) -> None:
+        self.max_keys = max_keys
+        self.counts: Dict[str, int] = {}
+
+    def record(self, key: str) -> None:
+        if self.max_keys == 0:
+            return
+        key = key[:MAX_KEY_LENGTH]
+        self.counts[key] = self.counts.get(key, 0) + 1
+        if len(self.counts) > self.max_keys * 3:
+            self._prune()
+
+    def _prune(self) -> None:
+        top = sorted(self.counts.items(), key=lambda kv: -kv[1])[
+            : self.max_keys
+        ]
+        self.counts = dict(top)
+
+    def top(self) -> List[Tuple[str, int]]:
+        return sorted(self.counts.items(), key=lambda kv: -kv[1])[
+            : self.max_keys
+        ]
+
+
+class Metrics:
+    """Request counters + optional top-denied-keys tracking."""
+
+    def __init__(self, max_denied_keys: int = 0) -> None:
+        self.start_time = time.time()
+        self.requests_total = 0
+        self.requests_by_transport: Dict[str, int] = {
+            "http": 0,
+            "grpc": 0,
+            "redis": 0,
+        }
+        self.requests_allowed = 0
+        self.requests_denied = 0
+        self.requests_errors = 0
+        max_denied_keys = min(max_denied_keys, MAX_TRACKED_DENIED_KEYS)
+        self.top_denied: Optional[TopDeniedKeys] = (
+            TopDeniedKeys(max_denied_keys) if max_denied_keys > 0 else None
+        )
+        # TPU-backend extras (no reference equivalent).
+        self.device_launches = 0
+        self.batched_requests = 0
+        self.max_batch = 0
+        self.sweeps = 0
+        self.slots_freed = 0
+
+    @classmethod
+    def builder(cls) -> "MetricsBuilder":
+        return MetricsBuilder()
+
+    # ------------------------------------------------------------------ #
+
+    def record_request(self, transport: str, allowed: bool) -> None:
+        self.requests_total += 1
+        if transport in self.requests_by_transport:
+            self.requests_by_transport[transport] += 1
+        if allowed:
+            self.requests_allowed += 1
+        else:
+            self.requests_denied += 1
+
+    def record_request_with_key(
+        self, transport: str, allowed: bool, key: str
+    ) -> None:
+        """metrics.rs:162-173: denied keys feed the leaderboard."""
+        self.record_request(transport, allowed)
+        if not allowed and self.top_denied is not None:
+            self.top_denied.record(key)
+
+    def record_error(self, transport: str) -> None:
+        self.requests_total += 1
+        if transport in self.requests_by_transport:
+            self.requests_by_transport[transport] += 1
+        self.requests_errors += 1
+
+    def record_launch(self, batch_size: int) -> None:
+        self.device_launches += 1
+        self.batched_requests += batch_size
+        self.max_batch = max(self.max_batch, batch_size)
+
+    def record_sweep(self, freed: int) -> None:
+        self.sweeps += 1
+        self.slots_freed += freed
+
+    # ------------------------------------------------------------------ #
+
+    def uptime_seconds(self) -> int:
+        return int(time.time() - self.start_time)
+
+    def export_prometheus(self) -> str:
+        """Prometheus text format, reference names (metrics.rs:233-310)."""
+        out = []
+
+        def metric(name, help_, typ, value):
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {typ}")
+            out.append(f"{name} {value}")
+
+        metric(
+            "throttlecrab_uptime_seconds",
+            "Server uptime in seconds",
+            "counter",
+            self.uptime_seconds(),
+        )
+        metric(
+            "throttlecrab_requests_total",
+            "Total number of requests",
+            "counter",
+            self.requests_total,
+        )
+        out.append(
+            "# HELP throttlecrab_requests_by_transport "
+            "Requests by transport type"
+        )
+        out.append("# TYPE throttlecrab_requests_by_transport counter")
+        for transport, count in sorted(self.requests_by_transport.items()):
+            out.append(
+                f'throttlecrab_requests_by_transport{{transport="{transport}"}}'
+                f" {count}"
+            )
+        metric(
+            "throttlecrab_requests_allowed",
+            "Number of allowed requests",
+            "counter",
+            self.requests_allowed,
+        )
+        metric(
+            "throttlecrab_requests_denied",
+            "Number of denied requests",
+            "counter",
+            self.requests_denied,
+        )
+        metric(
+            "throttlecrab_requests_errors",
+            "Number of error responses",
+            "counter",
+            self.requests_errors,
+        )
+        if self.top_denied is not None:
+            out.append(
+                "# HELP throttlecrab_top_denied_keys "
+                "Top denied keys by count"
+            )
+            out.append("# TYPE throttlecrab_top_denied_keys gauge")
+            for rank, (key, count) in enumerate(self.top_denied.top(), 1):
+                escaped = escape_label_value(key)
+                out.append(
+                    f'throttlecrab_top_denied_keys{{key="{escaped}",'
+                    f'rank="{rank}"}} {count}'
+                )
+        # TPU-backend extensions.
+        metric(
+            "throttlecrab_tpu_device_launches",
+            "Number of device kernel launches",
+            "counter",
+            self.device_launches,
+        )
+        metric(
+            "throttlecrab_tpu_batched_requests",
+            "Requests decided through batched launches",
+            "counter",
+            self.batched_requests,
+        )
+        metric(
+            "throttlecrab_tpu_max_batch_size",
+            "Largest batch coalesced into one launch",
+            "gauge",
+            self.max_batch,
+        )
+        metric(
+            "throttlecrab_tpu_sweeps",
+            "Expiry compaction sweeps executed",
+            "counter",
+            self.sweeps,
+        )
+        metric(
+            "throttlecrab_tpu_slots_freed",
+            "Slots freed by compaction sweeps",
+            "counter",
+            self.slots_freed,
+        )
+        return "\n".join(out) + "\n"
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus label escaping (metrics.rs:213-230)."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+class MetricsBuilder:
+    """Builder mirroring metrics.rs:101-142."""
+
+    def __init__(self) -> None:
+        self._max_denied_keys = 0
+
+    def max_denied_keys(self, n: int) -> "MetricsBuilder":
+        self._max_denied_keys = n
+        return self
+
+    def build(self) -> Metrics:
+        return Metrics(max_denied_keys=self._max_denied_keys)

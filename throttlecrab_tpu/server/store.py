@@ -1,0 +1,50 @@
+"""Limiter/policy factory: config → engine parts (reference: store.rs:57-87).
+
+The reference's factory picks one of three store types and spawns the
+matching actor; here the "store" choice selects the cleanup policy (the
+bucket table itself is always the TPU SoA table), and `shards` selects
+between the single-device and mesh-sharded limiter.
+"""
+
+from __future__ import annotations
+
+from ..tpu.cleanup import CleanupPolicy, make_policy
+from ..tpu.limiter import TpuRateLimiter
+
+
+def create_limiter(config):
+    """Build the device limiter the engine will drive."""
+    if config.shards > 1:
+        from ..parallel.sharded import ShardedTpuRateLimiter, make_mesh
+
+        mesh = make_mesh(config.shards)
+        return ShardedTpuRateLimiter(
+            capacity_per_shard=max(
+                config.store_capacity // config.shards, 1024
+            ),
+            mesh=mesh,
+            keymap=config.keymap,
+        )
+    return TpuRateLimiter(
+        capacity=config.store_capacity,
+        keymap=config.keymap,
+    )
+
+
+def create_cleanup_policy(config) -> CleanupPolicy:
+    """store.rs:57-87: the store type decides when cleanup runs."""
+    if config.store == "periodic":
+        return make_policy(
+            "periodic", cleanup_interval_secs=config.store_cleanup_interval
+        )
+    if config.store == "probabilistic":
+        return make_policy(
+            "probabilistic",
+            cleanup_probability=config.store_cleanup_probability,
+        )
+    return make_policy(
+        "adaptive",
+        min_interval_secs=config.store_min_interval,
+        max_interval_secs=config.store_max_interval,
+        max_operations=config.store_max_operations,
+    )

@@ -321,6 +321,11 @@ class TpuRateLimiter(ScalarCompatMixin):
     def __len__(self) -> int:
         return len(self.keymap)
 
+    @property
+    def total_capacity(self) -> int:
+        """Slots available before growth (for capacity-pressure policies)."""
+        return self.table.capacity
+
     # ------------------------------------------------------------------ #
 
     @staticmethod
