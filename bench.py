@@ -1,0 +1,204 @@
+"""Headline benchmark: rate-limit decisions/sec on the TPU engine.
+
+BASELINE.json config 3 — 1M distinct keys, Zipf-1.1 hot-key skew,
+batch = 4096, per-key heterogeneous (burst, count, period) — measured
+end-to-end through the host path (key→slot resolution + segment structure +
+device launch + result fetch), i.e. what a serving deployment pays per
+decision.  Launches are K-deep scans (kernel.gcra_scan) so the multi-ms
+tunnel launch overhead amortizes across K micro-batches, exactly how the
+batching engine dispatches under sustained load.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "decisions/s", "vs_baseline": N}
+
+vs_baseline compares against the reference's best in-process library number
+(AdaptiveStore, 12.5M req/s on Apple M3 Max over 2k keys —
+docs/benchmark-results.md:28-32); this benchmark carries 500x that key
+cardinality.
+
+Flags: --cpu (force CPU backend for local runs), --quick (fewer batches),
+--json-extra (dump latency percentiles to stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_BASELINE = 12_500_000.0  # req/s, reference library AdaptiveStore
+
+N_KEYS = 1_000_000
+BATCH = 4096
+SCAN_DEPTH = 16  # micro-batches per device launch
+ZIPF_A = 1.1
+NS = 1_000_000_000
+T0 = 1_753_000_000 * NS
+
+
+def zipf_indices(rng, n_keys, size, a=ZIPF_A):
+    """Bounded Zipf(a) ranks in [0, n_keys) via explicit probabilities."""
+    ranks = np.arange(1, n_keys + 1, dtype=np.float64)
+    p = ranks ** -a
+    p /= p.sum()
+    return rng.choice(n_keys, size=size, p=p)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json-extra", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import throttlecrab_tpu  # noqa: F401  (enables x64)
+    import jax
+
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter, derive_params
+    from throttlecrab_tpu.tpu.limiter import segment_info  # noqa: F401
+
+    device = jax.devices()[0]
+    print(f"bench device: {device}", file=sys.stderr)
+
+    rng = np.random.default_rng(7)
+    n_keys = 100_000 if args.quick else N_KEYS
+    timed_batches = 64 if args.quick else 512
+    warm_batches = 16 if args.quick else 64
+
+    limiter = TpuRateLimiter(capacity=1 << 21, keymap="auto", auto_grow=False)
+    keymap_kind = type(limiter.keymap).__name__
+    print(f"keymap: {keymap_kind}", file=sys.stderr)
+
+    # Per-key heterogeneous parameters (BASELINE config 3), derived
+    # deterministically from the key id.
+    kid = np.arange(n_keys, dtype=np.int64)
+    burst_all = 5 + (kid % 60)
+    count_all = 50 + (kid % 1000)
+    period_all = 30 + (kid % 120)
+    keys = [b"bench:key:%d" % i for i in range(n_keys)]
+
+    em_all, tol_all, _ = derive_params(burst_all, count_all, period_all)
+
+    bytes_keys = getattr(limiter.keymap, "BYTES_KEYS", False)
+    key_src = keys if bytes_keys else [k.decode() for k in keys]
+
+    # ---- populate: resolve every key once (compiles the kernel too) ------
+    t_pop = time.perf_counter()
+    pop_order = rng.permutation(n_keys)
+    for start in range(0, n_keys, BATCH * SCAN_DEPTH):
+        chunk = pop_order[start : start + BATCH * SCAN_DEPTH]
+        run_launch(limiter, key_src, chunk, em_all, tol_all, T0)
+    print(
+        f"populated {len(limiter)} keys in "
+        f"{time.perf_counter() - t_pop:.1f}s",
+        file=sys.stderr,
+    )
+
+    # ---- workload: Zipf-skewed batches -----------------------------------
+    total = (warm_batches + timed_batches) * BATCH
+    draws = zipf_indices(rng, n_keys, total)
+
+    launch_times = []
+    decided = 0
+    t_start = None
+    n_launches = (warm_batches + timed_batches) // SCAN_DEPTH
+    per_launch = BATCH * SCAN_DEPTH
+    warm_launches = warm_batches // SCAN_DEPTH
+    for li in range(n_launches):
+        chunk = draws[li * per_launch : (li + 1) * per_launch]
+        t0 = time.perf_counter()
+        run_launch(
+            limiter, key_src, chunk, em_all, tol_all, T0 + li * 50_000_000
+        )
+        dt = time.perf_counter() - t0
+        if li == warm_launches - 1:
+            t_start = time.perf_counter()
+        elif li >= warm_launches:
+            launch_times.append(dt)
+            decided += per_launch
+    elapsed = time.perf_counter() - t_start
+    rate = decided / elapsed
+
+    lat = np.sort(np.asarray(launch_times))
+    extra = {
+        "elapsed_s": round(elapsed, 3),
+        "decisions": decided,
+        "launch_p50_ms": round(float(lat[int(0.50 * len(lat))]) * 1e3, 3),
+        "launch_p99_ms": round(
+            float(lat[min(int(0.99 * len(lat)), len(lat) - 1)]) * 1e3, 3
+        ),
+        "scan_depth": SCAN_DEPTH,
+        "batch": BATCH,
+        "n_keys": n_keys,
+        "keymap": keymap_kind,
+        "device": str(device),
+    }
+    print(json.dumps(extra), file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "rate-limit decisions/sec "
+                    f"({n_keys // 1000}k keys, Zipf-1.1, batch={BATCH})"
+                ),
+                "value": round(rate),
+                "unit": "decisions/s",
+                "vs_baseline": round(rate / REFERENCE_BASELINE, 3),
+            }
+        )
+    )
+    return 0
+
+
+def run_launch(limiter, key_src, idx_chunk, em_all, tol_all, now_ns):
+    """One K-deep device launch over `idx_chunk` key ids (host path incl.
+    key resolution and segment structure, like the serving engine)."""
+    import numpy as np
+
+    from throttlecrab_tpu.tpu.limiter import segment_info
+
+    n = len(idx_chunk)
+    k = max(n // BATCH, 1)
+    n = k * BATCH  # truncate ragged tail
+    idx = idx_chunk[:n]
+
+    slots = np.empty(n, np.int32)
+    rank = np.empty(n, np.int32)
+    is_last = np.empty(n, bool)
+    valid = np.ones(BATCH, bool)
+    for j in range(k):
+        sel = idx[j * BATCH : (j + 1) * BATCH]
+        batch_keys = [key_src[i] for i in sel]
+        sl, rk, il, n_full = limiter.keymap.resolve(batch_keys, valid)
+        assert not n_full
+        slots[j * BATCH : (j + 1) * BATCH] = sl
+        rank[j * BATCH : (j + 1) * BATCH] = rk
+        is_last[j * BATCH : (j + 1) * BATCH] = il
+
+    shape = (k, BATCH)
+    out = limiter.table.check_many(
+        slots.reshape(shape),
+        rank.reshape(shape),
+        is_last.reshape(shape),
+        em_all[idx].reshape(shape),
+        tol_all[idx].reshape(shape),
+        np.ones(shape, np.int64),
+        np.ones(shape, bool),
+        np.full(k, now_ns, np.int64),
+        with_degen=False,  # host-certified: qty=1, burst>1, emission>0
+        compact=True,  # i32 wire outputs, half the fetch bytes
+    )
+    return np.asarray(out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
