@@ -1,0 +1,4 @@
+from .loadgen import main
+import sys
+
+sys.exit(main())

@@ -1,0 +1,295 @@
+"""Multi-transport load generator.
+
+The `integration-tests` crate equivalent
+(`perf_test_multi_transport.rs:48-443`): N concurrent workers with
+pre-generated payloads, start-barrier synchronization, per-transport clients
+(HTTP keep-alive, RESP pipeline-per-connection, gRPC channel), and
+p50/p90/p99/p99.9 latency percentiles.
+
+Run against a live server:
+  python -m throttlecrab_tpu.harness perf-test \
+      --transport http --port 8080 --workers 32 --requests 10000
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List
+
+from .workload import Workload, make_keys
+
+
+@dataclass
+class PerfResult:
+    transport: str
+    total_requests: int
+    elapsed_s: float
+    allowed: int
+    denied: int
+    errors: int
+    latencies_s: List[float] = field(default_factory=list, repr=False)
+
+    @property
+    def rps(self) -> float:
+        return self.total_requests / self.elapsed_s if self.elapsed_s else 0.0
+
+    def percentile_ms(self, q: float) -> float:
+        if not self.latencies_s:
+            return 0.0
+        data = sorted(self.latencies_s)
+        idx = min(int(q * len(data)), len(data) - 1)
+        return data[idx] * 1e3
+
+    def summary(self) -> dict:
+        return {
+            "transport": self.transport,
+            "requests": self.total_requests,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "rps": round(self.rps),
+            "allowed": self.allowed,
+            "denied": self.denied,
+            "errors": self.errors,
+            "p50_ms": round(self.percentile_ms(0.50), 3),
+            "p90_ms": round(self.percentile_ms(0.90), 3),
+            "p99_ms": round(self.percentile_ms(0.99), 3),
+            "p99_9_ms": round(self.percentile_ms(0.999), 3),
+        }
+
+
+# ---------------------------------------------------------------- clients #
+
+
+class HttpClient:
+    """Keep-alive HTTP/1.1 client on asyncio streams."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self.reader = None
+        self.writer = None
+
+    async def connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+
+    async def throttle(self, key: str, burst: int, count: int, period: int):
+        body = json.dumps(
+            {
+                "key": key,
+                "max_burst": burst,
+                "count_per_period": count,
+                "period": period,
+            }
+        ).encode()
+        self.writer.write(
+            b"POST /throttle HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+        )
+        await self.writer.drain()
+        head = await self.reader.readuntil(b"\r\n\r\n")
+        status = int(head.split(b" ", 2)[1])
+        length = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                length = int(line.split(b":", 1)[1])
+        payload = await self.reader.readexactly(length)
+        if status != 200:
+            return None
+        return json.loads(payload)["allowed"]
+
+    async def close(self) -> None:
+        if self.writer:
+            self.writer.close()
+
+
+class RedisClient:
+    """RESP client issuing THROTTLE commands."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self.reader = None
+        self.writer = None
+        self._buf = b""
+
+    async def connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+
+    async def throttle(self, key: str, burst: int, count: int, period: int):
+        parts = [b"THROTTLE", key.encode(), str(burst).encode(),
+                 str(count).encode(), str(period).encode()]
+        frame = b"*%d\r\n" % len(parts) + b"".join(
+            b"$%d\r\n%s\r\n" % (len(p), p) for p in parts
+        )
+        self.writer.write(frame)
+        await self.writer.drain()
+        # Response: *5 int array (or -ERR line).
+        while self._buf.count(b"\r\n") < 1:
+            self._buf += await self.reader.read(4096)
+        if self._buf.startswith(b"-"):
+            line, _, self._buf = self._buf.partition(b"\r\n")
+            return None
+        while self._buf.count(b"\r\n") < 6:
+            self._buf += await self.reader.read(4096)
+        lines = self._buf.split(b"\r\n")
+        allowed = lines[1] == b":1"
+        self._buf = b"\r\n".join(lines[6:])
+        return allowed
+
+    async def close(self) -> None:
+        if self.writer:
+            self.writer.close()
+
+
+class GrpcClient:
+    """grpc.aio client for throttlecrab.RateLimiter/Throttle."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self.channel = None
+        self.method = None
+
+    async def connect(self) -> None:
+        import grpc.aio
+
+        from ..server.proto import throttlecrab_pb2 as pb
+
+        self._pb = pb
+        self.channel = grpc.aio.insecure_channel(f"{self.host}:{self.port}")
+        self.method = self.channel.unary_unary(
+            "/throttlecrab.RateLimiter/Throttle",
+            request_serializer=pb.ThrottleRequest.SerializeToString,
+            response_deserializer=pb.ThrottleResponse.FromString,
+        )
+
+    async def throttle(self, key: str, burst: int, count: int, period: int):
+        response = await self.method(
+            self._pb.ThrottleRequest(
+                key=key, max_burst=burst, count_per_period=count,
+                period=period, quantity=1,
+            )
+        )
+        return response.allowed
+
+    async def close(self) -> None:
+        if self.channel:
+            await self.channel.close()
+
+
+CLIENTS = {"http": HttpClient, "redis": RedisClient, "grpc": GrpcClient}
+
+
+# ----------------------------------------------------------------- runner #
+
+
+async def run_perf_test(
+    transport: str,
+    host: str,
+    port: int,
+    workers: int,
+    requests_per_worker: int,
+    burst: int = 100,
+    count: int = 10_000,
+    period: int = 60,
+    key_pattern: str = "random",
+    key_space: int = 10_000,
+    workload: str = "steady",
+    target_rps: float = 0.0,
+) -> PerfResult:
+    """Barrier-synchronized workers, pre-generated keys
+    (perf_test_multi_transport.rs:48-127)."""
+    clients = [CLIENTS[transport](host, port) for _ in range(workers)]
+    await asyncio.gather(*(c.connect() for c in clients))
+
+    all_keys = [
+        make_keys(key_pattern, requests_per_worker, key_space, seed=w)
+        for w in range(workers)
+    ]
+    barrier = asyncio.Barrier(workers)
+    result = PerfResult(transport, 0, 0.0, 0, 0, 0)
+
+    async def worker(w: int) -> None:
+        client = clients[w]
+        keys = all_keys[w]
+        wl = Workload(workload, target_rps, requests_per_worker)
+        await barrier.wait()
+        for key, delay in zip(keys, wl.delays()):
+            if delay > 0:
+                await asyncio.sleep(delay)
+            t0 = time.perf_counter()
+            try:
+                allowed = await client.throttle(key, burst, count, period)
+            except Exception:
+                result.errors += 1
+                continue
+            result.latencies_s.append(time.perf_counter() - t0)
+            if allowed is None:
+                result.errors += 1
+            elif allowed:
+                result.allowed += 1
+            else:
+                result.denied += 1
+
+    t_start = time.perf_counter()
+    await asyncio.gather(*(worker(w) for w in range(workers)))
+    result.elapsed_s = time.perf_counter() - t_start
+    result.total_requests = workers * requests_per_worker
+    await asyncio.gather(*(c.close() for c in clients))
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="throttlecrab-tpu-harness")
+    sub = ap.add_subparsers(dest="command", required=True)
+    p = sub.add_parser("perf-test", help="load-test a running server")
+    p.add_argument("--transport", default="http",
+                   choices=["http", "redis", "grpc", "all"])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--grpc-port", type=int, default=8070)
+    p.add_argument("--redis-port", type=int, default=6379)
+    p.add_argument("--workers", type=int, default=32)
+    p.add_argument("--requests", type=int, default=10_000,
+                   help="requests per worker")
+    p.add_argument("--key-pattern", default="random",
+                   choices=["sequential", "random", "zipfian",
+                            "user-resource"])
+    p.add_argument("--key-space", type=int, default=10_000)
+    p.add_argument("--workload", default="steady",
+                   choices=["steady", "burst", "ramp", "wave"])
+    p.add_argument("--target-rps", type=float, default=0.0,
+                   help="per-worker pacing (0 = open throttle)")
+    p.add_argument("--burst", type=int, default=100)
+    p.add_argument("--count", type=int, default=10_000)
+    p.add_argument("--period", type=int, default=60)
+    args = ap.parse_args(argv)
+
+    transports = (
+        ["http", "grpc", "redis"] if args.transport == "all"
+        else [args.transport]
+    )
+    ports = {"http": args.port, "grpc": args.grpc_port,
+             "redis": args.redis_port}
+    for transport in transports:
+        result = asyncio.run(
+            run_perf_test(
+                transport, args.host, ports[transport], args.workers,
+                args.requests, burst=args.burst, count=args.count,
+                period=args.period, key_pattern=args.key_pattern,
+                key_space=args.key_space, workload=args.workload,
+                target_rps=args.target_rps,
+            )
+        )
+        print(json.dumps(result.summary()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Workload shapes and key distributions for the load generator.
+
+Re-designs the reference's workload vocabulary
+(`tests/integration/workload.rs:8-52`): request-arrival patterns
+Steady / Burst / Ramp / Wave and key patterns Sequential / Random /
+Zipfian / UserResource.  Patterns are expressed as *per-request delay
+schedules* (host side), so they compose with any transport client.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Iterator, List
+
+import numpy as np
+
+
+@dataclass
+class Workload:
+    """A schedule of inter-request delays (seconds) for one worker."""
+
+    pattern: str  # steady | burst | ramp | wave
+    target_rps: float  # per-worker request rate
+    n_requests: int
+
+    def delays(self) -> Iterator[float]:
+        base = 1.0 / self.target_rps if self.target_rps > 0 else 0.0
+        if self.pattern == "steady":
+            for _ in range(self.n_requests):
+                yield base
+        elif self.pattern == "burst":
+            # bursts of 50 back-to-back, then a pause that restores the
+            # average rate (workload.rs Burst).
+            burst = 50
+            for i in range(self.n_requests):
+                yield 0.0 if i % burst else base * burst
+        elif self.pattern == "ramp":
+            # linear 0 → 2x target over the run (workload.rs Ramp).
+            for i in range(self.n_requests):
+                frac = (i + 1) / self.n_requests
+                rate = self.target_rps * 2 * frac
+                yield 1.0 / rate if rate > 0 else 0.0
+        elif self.pattern == "wave":
+            # sinusoidal around the target (workload.rs Wave).
+            for i in range(self.n_requests):
+                phase = math.sin(2 * math.pi * i / 1000)
+                rate = self.target_rps * (1 + 0.8 * phase)
+                yield 1.0 / rate if rate > 0 else 0.0
+        else:
+            raise ValueError(f"unknown workload pattern: {self.pattern!r}")
+
+
+def make_keys(
+    pattern: str, n_requests: int, key_space: int, seed: int = 0
+) -> List[str]:
+    """Key sequence per `workload.rs:43-52`'s KeyPattern."""
+    rng = np.random.default_rng(seed)
+    if pattern == "sequential":
+        ids = np.arange(n_requests) % key_space
+    elif pattern == "random":
+        ids = rng.integers(0, key_space, n_requests)
+    elif pattern == "zipfian":
+        ranks = np.arange(1, key_space + 1, dtype=np.float64)
+        p = ranks**-1.1
+        p /= p.sum()
+        ids = rng.choice(key_space, size=n_requests, p=p)
+    elif pattern == "user-resource":
+        users = rng.integers(0, max(key_space // 10, 1), n_requests)
+        resources = rng.integers(0, 10, n_requests)
+        return [f"user:{u}:res:{r}" for u, r in zip(users, resources)]
+    else:
+        raise ValueError(f"unknown key pattern: {pattern!r}")
+    return [f"key:{i}" for i in ids]
