@@ -1,0 +1,27 @@
+"""Embedded library use — the reference's examples/basic.rs equivalent."""
+
+import time
+
+import throttlecrab_tpu as tc
+
+
+def main() -> None:
+    limiter = tc.RateLimiter(tc.AdaptiveStore())
+    now = time.time_ns()
+    for i in range(7):
+        allowed, result = limiter.rate_limit(
+            "api:user:42",
+            max_burst=5,
+            count_per_period=100,
+            period=60,
+            quantity=1,
+            now_ns=now + i * 1_000,
+        )
+        print(
+            f"request {i}: allowed={allowed} remaining={result.remaining} "
+            f"retry_after={result.retry_after_ns / 1e9:.3f}s"
+        )
+
+
+if __name__ == "__main__":
+    main()

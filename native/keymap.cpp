@@ -187,6 +187,34 @@ int64_t tk_lookup_insert_batch(
     return full;
 }
 
+// Snapshot export: first call tk_export_sizes to size the buffers, then
+// tk_export fills slot ids, key offsets (n+1 entries) and key bytes for
+// every live entry, in unspecified order.
+void tk_export_sizes(void* h, int64_t* n_out, int64_t* bytes_out) {
+    KeyMap* m = static_cast<KeyMap*>(h);
+    int64_t bytes = 0;
+    for (const Entry& e : m->buckets)
+        if (e.key_off >= 0) bytes += e.key_len;
+    *n_out = m->size;
+    *bytes_out = bytes;
+}
+
+void tk_export(void* h, int32_t* slots_out, int64_t* offsets_out,
+               char* keys_out) {
+    KeyMap* m = static_cast<KeyMap*>(h);
+    int64_t i = 0;
+    int64_t off = 0;
+    for (const Entry& e : m->buckets) {
+        if (e.key_off < 0) continue;
+        slots_out[i] = e.slot;
+        offsets_out[i] = off;
+        memcpy(keys_out + off, m->arena.data() + e.key_off, e.key_len);
+        off += e.key_len;
+        i++;
+    }
+    offsets_out[i] = off;
+}
+
 // Free the given slots (from a sweep's expired mask).  Tombstone-free
 // removal for linear probing: re-place any displaced cluster members.
 int64_t tk_free_slots(void* h, const int32_t* slots, int64_t n) {

@@ -1,0 +1,106 @@
+"""Snapshot/restore tests (a TPU-framework extension; the reference keeps
+all state ephemeral by design — SURVEY §5 checkpoint row)."""
+
+import numpy as np
+import pytest
+
+from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+from throttlecrab_tpu.tpu.snapshot import load_snapshot, save_snapshot
+
+NS = 1_000_000_000
+T0 = 1_700_000_000 * NS
+
+
+@pytest.mark.parametrize("keymap", ["python", "native"])
+def test_snapshot_round_trip(tmp_path, keymap):
+    if keymap == "native":
+        from throttlecrab_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+    path = tmp_path / "snap.npz"
+    lim = TpuRateLimiter(capacity=256, keymap=keymap)
+    # Exhaust one key, touch others, with long TTLs.
+    for _ in range(3):
+        lim.rate_limit("hot", 3, 10, 3600, 1, T0)
+    keys = [f"k{i}" for i in range(50)]
+    lim.rate_limit_batch(keys, 5, 10, 3600, 1, T0)
+
+    n = save_snapshot(lim, path)
+    assert n == 51
+
+    lim2 = TpuRateLimiter(capacity=256, keymap=keymap)
+    restored = load_snapshot(lim2, path, now_ns=T0 + NS)
+    assert restored == 51
+    # Decisions continue exactly where the snapshot left off.
+    allowed, r = lim2.rate_limit("hot", 3, 10, 3600, 1, T0 + NS)
+    assert not allowed  # still exhausted after restore
+    allowed, r = lim2.rate_limit("k0", 5, 10, 3600, 1, T0 + NS)
+    assert allowed
+    assert r.remaining == 3  # one of five tokens was used pre-snapshot
+
+
+def test_restore_drops_expired_entries(tmp_path):
+    path = tmp_path / "snap.npz"
+    lim = TpuRateLimiter(capacity=64)
+    lim.rate_limit("short", 2, 10, 1, 1, T0)  # TTL ~1s
+    lim.rate_limit("long", 2, 10, 3600, 1, T0)  # TTL ~1h
+    save_snapshot(lim, path)
+
+    lim2 = TpuRateLimiter(capacity=64)
+    restored = load_snapshot(lim2, path, now_ns=T0 + 100 * NS)
+    assert restored == 1  # only "long" survives
+    assert len(lim2) == 1
+
+
+def test_restore_requires_empty_limiter(tmp_path):
+    path = tmp_path / "snap.npz"
+    lim = TpuRateLimiter(capacity=64)
+    lim.rate_limit("a", 2, 10, 60, 1, T0)
+    save_snapshot(lim, path)
+    with pytest.raises(ValueError):
+        load_snapshot(lim, path, now_ns=T0)
+
+
+def test_empty_snapshot(tmp_path):
+    path = tmp_path / "snap.npz"
+    lim = TpuRateLimiter(capacity=64)
+    assert save_snapshot(lim, path) == 0
+    lim2 = TpuRateLimiter(capacity=64)
+    assert load_snapshot(lim2, path, now_ns=T0) == 0
+
+
+def test_snapshot_binary_safe_keys(tmp_path):
+    """Keys with NUL bytes and non-UTF-8 bytes keys survive round trip."""
+    path = tmp_path / "snap.npz"
+    lim = TpuRateLimiter(capacity=64, keymap="python")
+    weird = ["a\x00b", "plain"]
+    weird_bytes = b"\xff\xfe"
+    for k in weird:
+        lim.rate_limit(k, 3, 10, 3600, 1, T0)
+        lim.rate_limit(k, 3, 10, 3600, 1, T0)
+    lim.rate_limit(weird_bytes, 3, 10, 3600, 1, T0)
+    assert save_snapshot(lim, path) == 3
+
+    lim2 = TpuRateLimiter(capacity=64, keymap="python")
+    assert load_snapshot(lim2, path, now_ns=T0 + NS) == 3
+    # Identity preserved: str stays str, bytes stays bytes, state continues.
+    _, r = lim2.rate_limit("a\x00b", 3, 10, 3600, 1, T0 + NS)
+    assert r.remaining == 0  # two of three tokens used pre-snapshot
+    _, r = lim2.rate_limit(weird_bytes, 3, 10, 3600, 1, T0 + NS)
+    assert r.remaining == 1
+    assert len(lim2) == 3  # no duplicate identities allocated
+
+
+def test_native_keymap_items_export():
+    from throttlecrab_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    from throttlecrab_tpu.native import NativeKeyMap
+
+    km = NativeKeyMap(32)
+    keys = [b"alpha", b"beta", b"gamma"]
+    slots, _, _, _ = km.resolve(keys, np.ones(3, bool))
+    exported = dict(km.items())
+    assert exported == {k: int(s) for k, s in zip(keys, slots)}

@@ -220,7 +220,7 @@ async def run_perf_test(
         keys = all_keys[w]
         wl = Workload(workload, target_rps, requests_per_worker)
         await barrier.wait()
-        for key, delay in zip(keys, wl.delays()):
+        for done, (key, delay) in enumerate(zip(keys, wl.delays())):
             if delay > 0:
                 await asyncio.sleep(delay)
             t0 = time.perf_counter()
@@ -228,6 +228,15 @@ async def run_perf_test(
                 allowed = await client.throttle(key, burst, count, period)
             except Exception:
                 result.errors += 1
+                # The stream may hold a half-read response; a reconnect is
+                # the only way to resynchronize the framing.  Abort the
+                # worker if the server is truly gone.
+                try:
+                    await client.close()
+                    await client.connect()
+                except Exception:
+                    result.errors += len(keys) - done - 1
+                    return
                 continue
             result.latencies_s.append(time.perf_counter() - t0)
             if allowed is None:

@@ -55,6 +55,12 @@ def _build() -> Optional[ctypes.CDLL]:
     ]
     lib.tk_free_slots.restype = ctypes.c_int64
     lib.tk_free_slots.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.tk_export_sizes.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.tk_export.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
     return lib
 
 
@@ -126,3 +132,26 @@ class NativeKeyMap:
 
     def grow(self, new_capacity: int) -> None:
         self._lib.tk_grow(self._h, new_capacity)
+
+    def items(self):
+        """(key_bytes, slot) pairs for every live entry (snapshot export)."""
+        n = ctypes.c_int64()
+        total = ctypes.c_int64()
+        self._lib.tk_export_sizes(
+            self._h, ctypes.byref(n), ctypes.byref(total)
+        )
+        n, total = n.value, total.value
+        slots = np.empty(n, np.int32)
+        offsets = np.empty(n + 1, np.int64)
+        blob = ctypes.create_string_buffer(max(total, 1))
+        self._lib.tk_export(
+            self._h,
+            slots.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            blob,
+        )
+        raw = blob.raw[:total]
+        return [
+            (raw[offsets[i] : offsets[i + 1]], int(slots[i]))
+            for i in range(n)
+        ]

@@ -67,6 +67,7 @@ async def run_server(config: Config) -> None:
         max_linger_us=config.max_linger_us,
         cleanup_policy=create_cleanup_policy(config),
         metrics=metrics,
+        profile_dir=config.profile_dir or None,
     )
     transports = build_transports(config, engine, metrics)
 

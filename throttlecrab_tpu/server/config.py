@@ -64,6 +64,8 @@ _SPEC = [
      "Host key->slot backend: auto, python, native"),
     ("shards", "THROTTLECRAB_SHARDS", 1, int,
      "Number of devices to shard the bucket table over"),
+    ("profile_dir", "THROTTLECRAB_PROFILE_DIR", "", str,
+     "Directory for an xprof trace of the first launches (empty: off)"),
 ]
 
 
@@ -92,6 +94,7 @@ class Config:
     max_linger_us: int = 200
     keymap: str = "auto"
     shards: int = 1
+    profile_dir: str = ""
 
     @classmethod
     def from_env_and_args(

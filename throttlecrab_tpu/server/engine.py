@@ -56,6 +56,8 @@ class BatchingEngine:
         cleanup_policy: Optional[CleanupPolicy] = None,
         metrics=None,
         now_fn=None,
+        profile_dir: Optional[str] = None,
+        profile_launches: int = 50,
     ) -> None:
         """`limiter` is a TpuRateLimiter / ShardedTpuRateLimiter (or any
         object with rate_limit_batch + sweep).  `now_fn` injects time for
@@ -77,6 +79,10 @@ class BatchingEngine:
         # Strong refs: the event loop only weakly references tasks, and a
         # GC'd flush task would strand its batch's futures forever.
         self._flush_tasks: set = set()
+        # Optional xprof capture of the first N launches (tpu/profiling.py).
+        self._profile_dir = profile_dir
+        self._profile_remaining = profile_launches if profile_dir else 0
+        self._profiling = False
 
     # ------------------------------------------------------------------ #
 
@@ -123,18 +129,23 @@ class BatchingEngine:
         futures = [f for _, f in batch]
         now_ns = self.now_fn()
         loop = asyncio.get_running_loop()
-        try:
-            result = await loop.run_in_executor(
-                None,
-                lambda: self.limiter.rate_limit_batch(
+        self._profile_tick()
+
+        def launch():
+            from ..tpu.profiling import annotate
+
+            with annotate("gcra_batch_decide"):
+                return self.limiter.rate_limit_batch(
                     [r.key for r in requests],
                     [r.max_burst for r in requests],
                     [r.count_per_period for r in requests],
                     [r.period for r in requests],
                     [r.quantity for r in requests],
                     now_ns,
-                ),
-            )
+                )
+
+        try:
+            result = await loop.run_in_executor(None, launch)
         except Exception as exc:  # internal failure fails the whole batch
             for fut in futures:
                 if not fut.done():
@@ -166,6 +177,22 @@ class BatchingEngine:
 
         await self._maybe_sweep(now_ns, len(batch))
 
+    def _profile_tick(self) -> None:
+        """Start/stop the xprof capture window around the first N launches."""
+        if self._profile_remaining <= 0:
+            if self._profiling:
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+                self._profiling = False
+            return
+        if not self._profiling:
+            import jax.profiler
+
+            jax.profiler.start_trace(self._profile_dir)
+            self._profiling = True
+        self._profile_remaining -= 1
+
     # ------------------------------------------------------------------ #
 
     async def _maybe_sweep(self, now_ns: int, n_ops: int) -> None:
@@ -191,3 +218,8 @@ class BatchingEngine:
             self._flush_handle.cancel()
             self._flush_handle = None
         await self._flush()
+        if self._profiling:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+            self._profiling = False

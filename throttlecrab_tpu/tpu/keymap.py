@@ -88,3 +88,7 @@ class PyKeyMap:
         self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
         self._rev.extend([None] * (new_capacity - self.capacity))
         self.capacity = new_capacity
+
+    def items(self):
+        """(key, slot) pairs for every live entry (snapshot export)."""
+        return list(self._map.items())

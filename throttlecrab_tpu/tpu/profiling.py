@@ -1,0 +1,43 @@
+"""Profiling / tracing hooks.
+
+The reference documents external profiling (Instruments, perf, flamegraph —
+`TESTING.md:112-143`) and ships structured logging; the TPU framework's
+equivalent is the JAX profiler: `trace()` wraps any region in an xprof
+trace you can open in TensorBoard/Perfetto, and `annotate()` labels device
+launches so batch dispatch shows up as named spans.
+
+Usage:
+    from throttlecrab_tpu.tpu.profiling import trace, annotate
+
+    with trace("/tmp/tc-trace"):        # captures device + host timeline
+        engine_work()
+
+    with annotate("gcra_batch"):        # names a span inside a trace
+        table.check_batch(...)
+
+The server exposes this as `THROTTLECRAB_PROFILE_DIR` — when set, the
+engine records a trace of the first N launches after startup.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def trace(log_dir: str):
+    """Capture a JAX profiler trace (xprof) into `log_dir`."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span for host/device timelines (no-op outside a trace)."""
+    import jax.profiler
+
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,130 @@
+"""Device-state snapshot / restore.
+
+The reference intentionally has no persistence — rate-limit state is soft,
+TTL-bounded, and a restart just resets buckets (SURVEY §5; the closest thing
+is its capacity documentation, `docs/capacity-behavior.md`).  On the TPU the
+whole table is two dense columns, which makes an optional point-in-time
+snapshot nearly free: fetch the SoA arrays to host, pair them with the
+keymap's key→slot assignment, and write one compressed npz.  Restoring hoists
+the arrays straight back into HBM.
+
+Snapshots are *best-effort soft state*: keys whose TTL lapsed between
+snapshot and restore are dropped by the restore-time sweep, so a stale
+snapshot degrades to an empty table — never to wrong decisions.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Union
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def save_snapshot(limiter, path: Union[str, Path]) -> int:
+    """Write the limiter's live state to `path` (.npz); returns #keys saved.
+
+    Works for TpuRateLimiter (single device).  Only live slots are saved:
+    tat/expiry columns plus each slot's key bytes.
+    """
+    path = Path(path)
+    tat = np.asarray(limiter.table.tat)
+    expiry = np.asarray(limiter.table.expiry)
+
+    slots = []
+    keys = []
+    key_is_bytes = []
+    for key, slot in limiter.keymap.items():
+        slots.append(slot)
+        key_is_bytes.append(isinstance(key, (bytes, bytearray)))
+        keys.append(bytes(key) if key_is_bytes[-1] else str(key).encode())
+    slots = np.asarray(slots, np.int64)
+
+    # Length-prefixed layout (offsets[n+1] + blob): binary-safe for keys
+    # containing any byte, including NUL.
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    if keys:
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+    key_blob = b"".join(keys)
+    np.savez_compressed(
+        path,
+        version=np.int64(FORMAT_VERSION),
+        capacity=np.int64(limiter.table.capacity),
+        slots=slots,
+        tat=tat[slots] if len(slots) else np.zeros(0, np.int64),
+        expiry=expiry[slots] if len(slots) else np.zeros(0, np.int64),
+        key_offsets=offsets,
+        key_blob=np.frombuffer(key_blob, np.uint8),
+        key_is_bytes=np.asarray(key_is_bytes, np.uint8),
+        meta=np.frombuffer(
+            json.dumps({"n_keys": len(keys)}).encode(), np.uint8
+        ),
+    )
+    return len(keys)
+
+
+def load_snapshot(limiter, path: Union[str, Path], now_ns: int) -> int:
+    """Restore a snapshot into a fresh limiter; returns #keys restored.
+
+    `now_ns` gates restoration: entries already expired are skipped (the
+    TTL contract holds across restarts).  The limiter must be empty.
+    """
+    if len(limiter) != 0:
+        raise ValueError("restore requires an empty limiter")
+    path = Path(path)
+    with np.load(path) as data:
+        version = int(data["version"])
+        if version != FORMAT_VERSION:
+            raise ValueError(f"unsupported snapshot version {version}")
+        tat = data["tat"]
+        expiry = data["expiry"]
+        offsets = data["key_offsets"]
+        key_blob = data["key_blob"].tobytes()
+        key_is_bytes = data["key_is_bytes"].astype(bool)
+        meta = json.loads(data["meta"].tobytes())
+
+    n = len(offsets) - 1
+    if meta["n_keys"] != n or len(tat) != n or len(expiry) != n:
+        raise ValueError("corrupt snapshot: array lengths disagree")
+    live = expiry > now_ns
+    restored = 0
+    batch_keys = []
+    batch_tat = []
+    batch_exp = []
+    for i in range(n):
+        if not live[i]:
+            continue
+        raw = key_blob[offsets[i] : offsets[i + 1]]
+        batch_keys.append(raw if key_is_bytes[i] else raw.decode())
+        batch_tat.append(int(tat[i]))
+        batch_exp.append(int(expiry[i]))
+        restored += 1
+
+    if restored:
+        _bulk_insert(limiter, batch_keys, batch_tat, batch_exp)
+    return restored
+
+
+def _bulk_insert(limiter, keys, tats, expiries) -> None:
+    """Allocate slots for `keys` and write their state rows directly."""
+    import jax.numpy as jnp
+
+    from .kernel import pack_state
+
+    if getattr(limiter.keymap, "BYTES_KEYS", False):
+        key_src = [k if isinstance(k, bytes) else k.encode() for k in keys]
+    else:
+        key_src = keys  # original identity preserved (str stays str)
+    valid = np.ones(len(keys), bool)
+    slots, _, _, n_full = limiter.keymap.resolve(key_src, valid)
+    if n_full:
+        raise ValueError("snapshot exceeds limiter capacity")
+    rows = pack_state(
+        jnp.asarray(tats, jnp.int64), jnp.asarray(expiries, jnp.int64)
+    )
+    limiter.table.state = limiter.table.state.at[
+        jnp.asarray(slots, jnp.int32)
+    ].set(rows)
