@@ -63,7 +63,6 @@ def main() -> int:
     import jax
 
     from throttlecrab_tpu.tpu.limiter import TpuRateLimiter, derive_params
-    from throttlecrab_tpu.tpu.limiter import segment_info  # noqa: F401
 
     device = jax.devices()[0]
     print(f"bench device: {device}", file=sys.stderr)
@@ -162,10 +161,6 @@ def main() -> int:
 def run_launch(limiter, key_src, idx_chunk, em_all, tol_all, now_ns):
     """One K-deep device launch over `idx_chunk` key ids (host path incl.
     key resolution and segment structure, like the serving engine)."""
-    import numpy as np
-
-    from throttlecrab_tpu.tpu.limiter import segment_info
-
     n = len(idx_chunk)
     k = max(n // BATCH, 1)
     n = k * BATCH  # truncate ragged tail

@@ -1,0 +1,137 @@
+"""In-process engine micro-benchmarks.
+
+The criterion-suite equivalent (`throttlecrab-server/benches/
+store_performance.rs:7-366`): single hot key, hot/cold 80/20, uniform
+random, sequential, zipfian, high-cardinality sweeps, and the three cleanup
+policies compared — but measured against the batched device engine, since
+that is this framework's hot path.  Prints one JSON line per scenario.
+
+Usage:
+  python benches/store_performance.py [--cpu] [--batch 4096] [--iters 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def bench_scenario(limiter, name, key_ids, batch, iters, params, now0):
+    """Time `iters` batches drawn from key_ids; returns decisions/s."""
+    n = len(key_ids)
+    burst, count, period = params
+    keys = [f"bench:{i}" for i in range(int(key_ids.max()) + 1)]
+    # warmup / compile
+    limiter.rate_limit_batch(
+        [keys[i] for i in key_ids[:batch]], burst, count, period, 1, now0
+    )
+    t0 = time.perf_counter()
+    for it in range(iters):
+        sel = key_ids[(it * batch) % n : (it * batch) % n + batch]
+        if len(sel) < batch:
+            sel = np.concatenate([sel, key_ids[: batch - len(sel)]])
+        limiter.rate_limit_batch(
+            [keys[i] for i in sel], burst, count, period, 1,
+            now0 + it * 1_000_000,
+        )
+    dt = time.perf_counter() - t0
+    rate = iters * batch / dt
+    print(json.dumps({
+        "scenario": name,
+        "decisions_per_sec": round(rate),
+        "batch": batch,
+        "iters": iters,
+    }))
+    return rate
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--iters", type=int, default=64)
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import throttlecrab_tpu  # noqa: F401
+
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    rng = np.random.default_rng(3)
+    B, iters = args.batch, args.iters
+    now0 = 1_753_000_000 * 1_000_000_000
+    total = B * iters
+    params = (100, 10_000, 60)
+
+    # Key distributions (store_performance.rs groups).
+    scenarios = {
+        "single_hot_key": np.zeros(total, np.int64),
+        "hot_keys_80_20": np.where(
+            rng.random(total) < 0.8,
+            rng.integers(0, 20, total),  # 80% of traffic on 20 keys
+            rng.integers(20, 2000, total),
+        ),
+        "uniform_random_2k": rng.integers(0, 2000, total),
+        "sequential_2k": np.arange(total, dtype=np.int64) % 2000,
+        "zipfian_100k": None,  # built below
+        "high_cardinality_100k": rng.permutation(
+            np.arange(total, dtype=np.int64) % 100_000
+        ),
+    }
+    ranks = np.arange(1, 100_001, dtype=np.float64)
+    p = ranks**-1.1
+    p /= p.sum()
+    scenarios["zipfian_100k"] = rng.choice(100_000, size=total, p=p)
+
+    for name, ids in scenarios.items():
+        limiter = TpuRateLimiter(capacity=1 << 18, keymap="auto")
+        bench_scenario(limiter, name, ids, B, iters, params, now0)
+
+    # Cleanup-policy comparison on the zipfian workload
+    # (store comparison group in the reference bench).
+    from throttlecrab_tpu.server.engine import BatchingEngine  # noqa: F401
+    from throttlecrab_tpu.tpu.cleanup import make_policy
+
+    for policy_name in ("periodic", "probabilistic", "adaptive"):
+        limiter = TpuRateLimiter(capacity=1 << 18, keymap="auto")
+        policy = make_policy(policy_name)
+        ids = scenarios["zipfian_100k"]
+        keys = [f"bench:{i}" for i in range(100_000)]
+        limiter.rate_limit_batch(
+            [keys[i] for i in ids[:B]], *params, 1, now0
+        )
+        t0 = time.perf_counter()
+        for it in range(iters):
+            sel = ids[(it * B) % total : (it * B) % total + B]
+            if len(sel) < B:
+                sel = np.concatenate([sel, ids[: B - len(sel)]])
+            now = now0 + it * 1_000_000
+            limiter.rate_limit_batch(
+                [keys[i] for i in sel], *params, 1, now
+            )
+            policy.record_ops(B)
+            if policy.should_clean(now, len(limiter), limiter.total_capacity):
+                freed = limiter.sweep(now)
+                policy.after_sweep(now, freed, len(limiter) + freed)
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "scenario": f"policy_{policy_name}_zipfian",
+            "decisions_per_sec": round(iters * B / dt),
+            "batch": B,
+            "iters": iters,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

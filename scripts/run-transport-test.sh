@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# End-to-end transport perf driver — the run-transport-test.sh equivalent
+# (integration-tests/run-transport-test.sh): boots the server with every
+# transport on high ports, runs the load generator per transport, then
+# shuts the server down.
+#
+# Usage: scripts/run-transport-test.sh [-t http|grpc|redis|all] [-T workers]
+#        [-r requests-per-worker] [--cpu]
+set -euo pipefail
+
+TRANSPORT=all
+WORKERS=32
+REQUESTS=1000
+HTTP_PORT=58080
+GRPC_PORT=58070
+REDIS_PORT=58060
+EXTRA_ENV=()
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -t) TRANSPORT="$2"; shift 2 ;;
+    -T) WORKERS="$2"; shift 2 ;;
+    -r) REQUESTS="$2"; shift 2 ;;
+    --cpu) EXTRA_ENV+=("THROTTLECRAB_BENCH_CPU=1"); shift ;;
+    *) echo "unknown arg: $1" >&2; exit 2 ;;
+  esac
+done
+
+cd "$(dirname "$0")/.."
+
+PYBOOT='
+import os
+if os.environ.get("THROTTLECRAB_BENCH_CPU"):
+    import jax; jax.config.update("jax_platforms", "cpu")
+import sys
+from throttlecrab_tpu.server.__main__ import main
+sys.exit(main(sys.argv[1:]))
+'
+
+env "${EXTRA_ENV[@]}" python -c "$PYBOOT" \
+    --http --http-port "$HTTP_PORT" \
+    --grpc --grpc-port "$GRPC_PORT" \
+    --redis --redis-port "$REDIS_PORT" \
+    --store adaptive --log-level warn &
+SERVER_PID=$!
+trap 'kill "$SERVER_PID" 2>/dev/null || true' EXIT
+
+# Wait for readiness via /health.
+for _ in $(seq 1 120); do
+  if curl -sf -m 1 "localhost:$HTTP_PORT/health" >/dev/null 2>&1; then
+    break
+  fi
+  sleep 0.5
+done
+curl -sf -m 2 "localhost:$HTTP_PORT/health" >/dev/null
+
+python -m throttlecrab_tpu.harness perf-test \
+    --transport "$TRANSPORT" \
+    --port "$HTTP_PORT" --grpc-port "$GRPC_PORT" --redis-port "$REDIS_PORT" \
+    --workers "$WORKERS" --requests "$REQUESTS" --key-pattern zipfian
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+trap - EXIT
+echo "done"

@@ -22,7 +22,6 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import zlib
-from functools import partial
 from typing import Optional, Sequence
 
 import jax
