@@ -27,6 +27,7 @@ from typing import List, Optional, Tuple
 
 from ..tpu.cleanup import CleanupPolicy
 from ..tpu.limiter import (
+    STATUS_INTERNAL,
     STATUS_INVALID_PARAMS,
     STATUS_NEGATIVE_QUANTITY,
     STATUS_OK,
@@ -36,6 +37,7 @@ from .types import ThrottleRequest, ThrottleResponse
 STATUS_MESSAGES = {
     STATUS_NEGATIVE_QUANTITY: "quantity cannot be negative",
     STATUS_INVALID_PARAMS: "invalid rate limit parameters",
+    STATUS_INTERNAL: "internal error",
 }
 
 
@@ -116,13 +118,80 @@ class BatchingEngine:
         self._flush_tasks.add(task)
         task.add_done_callback(self._flush_tasks.discard)
 
+    MAX_SCAN_DEPTH = 16  # backlog sub-batches decided per launch
+
     async def _flush(self) -> None:
-        """Decide everything pending (in arrival order), batch by batch."""
+        """Decide everything pending (in arrival order).
+
+        A backlog deeper than one batch drains through the scan path —
+        up to MAX_SCAN_DEPTH full batches in a single device launch
+        (limiter.rate_limit_many), amortizing the fixed dispatch cost."""
+        can_scan = hasattr(self.limiter, "rate_limit_many")
         async with self._flush_lock:
             while self._pending:
-                batch = self._pending[: self.batch_size]
-                del self._pending[: len(batch)]
-                await self._decide(batch)
+                n_batches = (
+                    min(
+                        max(len(self._pending) // self.batch_size, 1),
+                        self.MAX_SCAN_DEPTH,
+                    )
+                    if can_scan
+                    else 1
+                )
+                take = min(
+                    n_batches * self.batch_size, len(self._pending)
+                )
+                window = self._pending[:take]
+                del self._pending[:take]
+                if n_batches > 1:
+                    await self._decide_many(
+                        [
+                            window[i : i + self.batch_size]
+                            for i in range(0, take, self.batch_size)
+                        ]
+                    )
+                else:
+                    await self._decide(window)
+
+    async def _decide_many(self, windows) -> None:
+        """Backlog path: K sub-batches, one launch, shared timestamp."""
+        now_ns = self.now_fn()
+        loop = asyncio.get_running_loop()
+        self._profile_tick()
+
+        def launch():
+            from ..tpu.profiling import annotate
+
+            with annotate("gcra_scan_decide"):
+                return self.limiter.rate_limit_many(
+                    [
+                        (
+                            [r.key for r, _ in window],
+                            [r.max_burst for r, _ in window],
+                            [r.count_per_period for r, _ in window],
+                            [r.period for r, _ in window],
+                            [r.quantity for r, _ in window],
+                            now_ns,
+                        )
+                        for window in windows
+                    ]
+                )
+
+        try:
+            results = await loop.run_in_executor(None, launch)
+        except Exception as exc:
+            for window in windows:
+                for _, fut in window:
+                    if not fut.done():
+                        fut.set_exception(ThrottleError(str(exc)))
+            return
+
+        total = 0
+        for window, result in zip(windows, results):
+            total += len(window)
+            self._complete(window, result)
+        if self.metrics is not None:
+            self.metrics.record_launch(total)
+        await self._maybe_sweep(now_ns, total)
 
     async def _decide(self, batch) -> None:
         requests = [r for r, _ in batch]
@@ -154,7 +223,13 @@ class BatchingEngine:
 
         if self.metrics is not None:
             self.metrics.record_launch(len(batch))
-        for i, fut in enumerate(futures):
+        self._complete(batch, result)
+        await self._maybe_sweep(now_ns, len(batch))
+
+    @staticmethod
+    def _complete(batch, result) -> None:
+        """Resolve each request's future from its BatchResult row."""
+        for i, (_, fut) in enumerate(batch):
             if fut.done():
                 continue
             status = int(result.status[i])
@@ -174,8 +249,6 @@ class BatchingEngine:
                         retry_after_ns=int(result.retry_after_ns[i]),
                     )
                 )
-
-        await self._maybe_sweep(now_ns, len(batch))
 
     def _profile_tick(self) -> None:
         """Start/stop the xprof capture window around the first N launches."""

@@ -45,6 +45,7 @@ I64_MAX = (1 << 63) - 1
 STATUS_OK = 0
 STATUS_NEGATIVE_QUANTITY = 1
 STATUS_INVALID_PARAMS = 2
+STATUS_INTERNAL = 3
 
 
 def segment_info(slots, mask):
@@ -235,32 +236,10 @@ class TpuRateLimiter(ScalarCompatMixin):
         `keys` is a sequence of hashable keys (str/bytes); the numeric
         parameters broadcast to its length.  `now_ns` must be >= 0.
         """
-        if now_ns < 0:
-            raise ValueError(
-                "batch now_ns must be non-negative; apply "
-                "normalize_now_ns per request for pre-epoch clocks"
-            )
-        n = len(keys)
-        if getattr(self.keymap, "BYTES_KEYS", False):
-            keys = [k.encode() if isinstance(k, str) else k for k in keys]
-        max_burst, quantity, emission, tolerance, status, valid = (
-            prepare_batch(n, max_burst, count_per_period, period, quantity)
+        (n, max_burst, quantity, emission, tolerance, status, valid,
+         slots, rank0, is_last0, rounds) = self._prepare_one(
+            keys, max_burst, count_per_period, period, quantity, now_ns
         )
-
-        slots, rank0, is_last0, n_full = self.keymap.resolve(keys, valid)
-        while n_full:
-            if not self.auto_grow:
-                raise InternalError("bucket table full")
-            new_capacity = max(self.keymap.capacity * 2, 1024)
-            self.keymap.grow(new_capacity)
-            self.table.grow(new_capacity)
-            missing = valid & (slots == -1)
-            slots2, _, _, n_full = self.keymap.resolve(keys, missing)
-            slots = np.where(missing, slots2, slots)
-            # Segment info must cover the merged batch.
-            rank0, is_last0 = segment_info(slots, valid)
-
-        rounds = self._conflict_rounds(slots, valid, emission, tolerance, quantity)
 
         pad = max(self.MIN_PAD, 1 << (n - 1).bit_length())
         slots_p = np.zeros(pad, np.int32)
@@ -310,6 +289,155 @@ class TpuRateLimiter(ScalarCompatMixin):
             retry_after_ns=retry_after,
             status=status,
         )
+
+    # ------------------------------------------------------------------ #
+
+    def _prepare_one(
+        self, keys, max_burst, count_per_period, period, quantity, now_ns
+    ):
+        """Shared per-batch prologue: validate, derive params, resolve
+        slots (growing on full), emit segment structure + conflict rounds.
+        One implementation for both the single-batch and scan paths."""
+        if now_ns < 0:
+            raise ValueError(
+                "batch now_ns must be non-negative; apply "
+                "normalize_now_ns per request for pre-epoch clocks"
+            )
+        n = len(keys)
+        if getattr(self.keymap, "BYTES_KEYS", False):
+            keys = [k.encode() if isinstance(k, str) else k for k in keys]
+        max_burst, quantity, emission, tolerance, status, valid = (
+            prepare_batch(n, max_burst, count_per_period, period, quantity)
+        )
+        slots, rank0, is_last0, n_full = self.keymap.resolve(keys, valid)
+        while n_full:
+            if not self.auto_grow:
+                raise InternalError("bucket table full")
+            new_capacity = max(self.keymap.capacity * 2, 1024)
+            self.keymap.grow(new_capacity)
+            self.table.grow(new_capacity)
+            missing = valid & (slots == -1)
+            slots2, _, _, n_full = self.keymap.resolve(keys, missing)
+            slots = np.where(missing, slots2, slots)
+            # Segment info must cover the merged batch.
+            rank0, is_last0 = segment_info(slots, valid)
+        rounds = self._conflict_rounds(
+            slots, valid, emission, tolerance, quantity
+        )
+        return (n, max_burst, quantity, emission, tolerance, status, valid,
+                slots, rank0, is_last0, rounds)
+
+    @staticmethod
+    def _error_result(n, status_code=STATUS_INTERNAL) -> BatchResult:
+        """All-requests-failed result (engine maps status → error)."""
+        return BatchResult(
+            allowed=np.zeros(n, bool),
+            limit=np.zeros(n, np.int64),
+            remaining=np.zeros(n, np.int64),
+            reset_after_ns=np.zeros(n, np.int64),
+            retry_after_ns=np.zeros(n, np.int64),
+            status=np.full(n, status_code, np.uint8),
+        )
+
+    def rate_limit_many(self, batches) -> list:
+        """Decide K whole batches in ONE device launch (gcra_scan).
+
+        `batches` is a list of (keys, max_burst, count_per_period, period,
+        quantity, now_ns) tuples, in arrival order; each sub-batch sees the
+        table state left by the previous one (lax.scan carry), exactly as K
+        separate rate_limit_batch calls would — but with one launch and one
+        fetch, amortizing the fixed dispatch cost that dominates when the
+        serving engine drains a backlog.  Returns a list of BatchResult.
+
+        Sub-batches whose keys change parameters mid-batch (conflict
+        rounds > 0) fall back to the per-batch path, preserving exact
+        ordering; that case is rare in serving traffic.
+        """
+        if not batches:
+            return []
+        if len(batches) == 1:
+            return [self.rate_limit_batch(*batches[0])]
+
+        prepared = []
+        width = self.MIN_PAD
+        for keys, max_burst, count_per_period, period, quantity, now_ns in (
+            batches
+        ):
+            (n, max_burst, quantity, emission, tolerance, status, valid,
+             slots, rank, is_last, rounds) = self._prepare_one(
+                keys, max_burst, count_per_period, period, quantity, now_ns
+            )
+            if rounds.any():
+                # A key changed parameters mid-batch: the multi-round
+                # sub-protocol interleaves with later sub-batches in ways a
+                # single scan cannot express, so decide the whole window
+                # sequentially (rare; exactness beats speed here).  Errors
+                # are isolated per batch — earlier batches' decisions are
+                # already committed on-device and must still be delivered.
+                out = []
+                failed = False
+                for b in batches:
+                    if failed:
+                        out.append(self._error_result(len(b[0])))
+                        continue
+                    try:
+                        out.append(self.rate_limit_batch(*b))
+                    except Exception:
+                        failed = True
+                        out.append(self._error_result(len(b[0])))
+                return out
+            prepared.append(
+                (n, slots, rank, is_last, emission, tolerance, quantity,
+                 valid, now_ns, max_burst, status)
+            )
+            width = max(width, 1 << max(n - 1, 0).bit_length())
+
+        K = len(prepared)
+        # Pad the scan depth to a power of two with empty sub-batches so the
+        # jit cache sees few distinct (K, width) shapes as backlog varies.
+        K_pad = 1 << (K - 1).bit_length()
+        shape = (K_pad, width)
+        slots_s = np.zeros(shape, np.int32)
+        rank_s = np.zeros(shape, np.int32)
+        last_s = np.ones(shape, bool)
+        em_s = np.zeros(shape, np.int64)
+        tol_s = np.zeros(shape, np.int64)
+        q_s = np.zeros(shape, np.int64)
+        valid_s = np.zeros(shape, bool)
+        now_s = np.full(K_pad, prepared[-1][8], np.int64)
+        for j, (n, slots, rank, is_last, emission, tolerance, quantity,
+                valid, now_ns, _mb, _st) in enumerate(prepared):
+            slots_s[j, :n] = slots
+            rank_s[j, :n] = rank
+            last_s[j, :n] = is_last
+            em_s[j, :n] = emission
+            tol_s[j, :n] = tolerance
+            q_s[j, :n] = quantity
+            valid_s[j, :n] = valid
+            now_s[j] = now_ns
+
+        out = np.asarray(
+            self.table.check_many(
+                slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s
+            )
+        )
+
+        results = []
+        for j, (n, slots, rank, is_last, emission, tolerance, quantity,
+                valid, now_ns, max_burst, status) in enumerate(prepared):
+            o = out[j, :, :n]
+            mask = valid_s[j, :n]
+            results.append(
+                BatchResult(
+                    allowed=(o[0] != 0) & mask,
+                    limit=np.where(valid, max_burst, 0),
+                    remaining=np.where(mask, o[1], 0),
+                    reset_after_ns=np.where(mask, o[2], 0),
+                    retry_after_ns=np.where(mask, o[3], 0),
+                    status=status,
+                )
+            )
+        return results
 
     # ------------------------------------------------------------------ #
 
