@@ -1,0 +1,627 @@
+// Native RESP wire front-end for the TPU rate limiter.
+//
+// The reference's transport hot path is tokio Rust (redis/mod.rs); here the
+// wire layer is a C++ epoll loop so the Python process spends its cycles
+// only on the batched device decide.  Division of labor:
+//
+//   IO thread (C++):   accept, read, RESP parse, PING/QUIT/parse errors
+//                      answered inline; THROTTLE requests assembled into
+//                      a lock-protected pending queue (key bytes + i64
+//                      params + connection cookie).
+//   driver (Python):   ws_next_batch() blocks until requests are pending
+//                      (or timeout), copies them into numpy arrays, runs
+//                      TpuRateLimiter.rate_limit_batch, then ws_respond()
+//                      hands the 5-integer results back.
+//   IO thread (C++):   serializes RESP arrays into per-connection output
+//                      buffers and flushes via epoll writability.
+//
+// The C++ side enforces the reference's connection hardening: 64 KB read
+// buffer cap and 5-minute idle timeout (redis/mod.rs:83-149).  Command
+// semantics mirror redis/mod.rs:150-296 (case-insensitive, argument
+// validation order, exact error strings).
+//
+// C ABI only (ctypes); no Python.h dependency.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t MAX_CONN_BUFFER = 64 * 1024;     // redis/mod.rs:83
+constexpr int64_t IDLE_TIMEOUT_MS = 300 * 1000;   // redis/mod.rs:99
+constexpr int64_t MAX_BULK = 512LL * 1024 * 1024; // resp.rs:8
+constexpr int64_t MAX_ARRAY = 1024 * 1024;        // resp.rs:9
+
+int64_t now_ms() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+struct PendingRequest {
+    uint64_t conn_gen;   // connection generation cookie
+    int fd;
+    std::string key;
+    int64_t max_burst, count_per_period, period, quantity;
+};
+
+struct Conn {
+    int fd = -1;
+    uint64_t gen = 0;
+    std::string rbuf;
+    std::string wbuf;
+    int64_t last_activity_ms = 0;
+    bool closing = false;     // close once wbuf drains
+    bool want_write = false;
+};
+
+// Incremental RESP array-of-bulk-strings parser (the only client frames the
+// reference accepts for commands; inline commands are not supported there
+// either).  Returns: 1 = one command parsed, 0 = need more data,
+// -1 = protocol error (err filled).
+int parse_command(const std::string& buf, size_t& consumed,
+                  std::vector<std::string>& out, std::string& err) {
+    out.clear();
+    size_t pos = 0;
+    auto read_line = [&](std::string& line) -> int {
+        size_t idx = buf.find("\r\n", pos);
+        if (idx == std::string::npos) return 0;
+        line.assign(buf, pos, idx - pos);
+        pos = idx + 2;
+        return 1;
+    };
+    auto parse_int = [](const std::string& s, int64_t& v) -> bool {
+        if (s.empty()) return false;
+        size_t i = (s[0] == '-' || s[0] == '+') ? 1 : 0;
+        if (i == s.size()) return false;
+        for (size_t j = i; j < s.size(); j++)
+            if (s[j] < '0' || s[j] > '9') return false;
+        errno = 0;
+        v = strtoll(s.c_str(), nullptr, 10);
+        return errno == 0;
+    };
+
+    if (buf.empty()) return 0;
+    if (buf[0] != '*') {
+        err = "ERR expected array of commands";
+        return -1;
+    }
+    std::string line;
+    if (!read_line(line)) return 0;
+    int64_t count;
+    if (!parse_int(line.substr(1), count) || count < -1 ||
+        count > MAX_ARRAY) {
+        err = "ERR Invalid array size";
+        return -1;
+    }
+    if (count <= 0) {
+        consumed = pos;
+        return 1;  // empty command → dispatch will answer
+    }
+    for (int64_t i = 0; i < count; i++) {
+        if (pos >= buf.size()) return 0;
+        if (buf[pos] != '$') {
+            err = "ERR invalid command format";
+            return -1;
+        }
+        if (!read_line(line)) return 0;
+        int64_t len;
+        if (!parse_int(line.substr(1), len) || len < -1 || len > MAX_BULK) {
+            err = "ERR Invalid bulk string length";
+            return -1;
+        }
+        if (len == -1) {
+            out.emplace_back();  // null bulk → empty (invalid for args)
+            continue;
+        }
+        if (buf.size() < pos + static_cast<size_t>(len) + 2) return 0;
+        out.emplace_back(buf, pos, len);
+        pos += len + 2;
+    }
+    consumed = pos;
+    return 1;
+}
+
+bool parse_i64_ascii(const std::string& s, int64_t& v) {
+    if (s.empty()) return false;
+    size_t i = (s[0] == '-' || s[0] == '+') ? 1 : 0;
+    if (i == s.size()) return false;
+    for (size_t j = i; j < s.size(); j++)
+        if (s[j] < '0' || s[j] > '9') return false;
+    errno = 0;
+    v = strtoll(s.c_str(), nullptr, 10);
+    return errno != ERANGE;
+}
+
+std::string upper(const std::string& s) {
+    std::string o = s;
+    for (char& c : o)
+        if (c >= 'a' && c <= 'z') c -= 32;
+    return o;
+}
+
+struct WireServer {
+    int listen_fd = -1;
+    int epoll_fd = -1;
+    int wake_fd = -1;   // responder → IO thread
+    uint16_t port = 0;
+    std::thread io_thread;
+    std::atomic<bool> running{false};
+
+    std::unordered_map<int, Conn> conns;
+    uint64_t next_gen = 1;
+
+    // IO thread → driver.  Bounded like the reference's mpsc channel
+    // (config.rs:311, default 100k): above the cap the IO thread stops
+    // reading sockets (real backpressure), resuming once the driver
+    // drains below half.
+    std::mutex q_mu;
+    std::condition_variable q_cv;
+    std::deque<PendingRequest> queue;
+    size_t queue_cap = 100000;
+    bool paused = false;
+
+    // driver → IO thread (serialized responses per conn).
+    std::mutex r_mu;
+    std::deque<std::pair<std::pair<uint64_t, int>, std::string>> responses;
+
+    // stats
+    std::atomic<uint64_t> n_conns{0}, n_requests{0}, n_inline{0};
+
+    bool start(const char* host, uint16_t want_port) {
+        listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+        if (listen_fd < 0) return false;
+        int one = 1;
+        setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_port = htons(want_port);
+        if (inet_pton(AF_INET, host, &addr.sin_addr) != 1)
+            addr.sin_addr.s_addr = INADDR_ANY;
+        if (bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                 sizeof(addr)) != 0)
+            return false;
+        if (listen(listen_fd, 1024) != 0) return false;
+        socklen_t alen = sizeof(addr);
+        getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+        port = ntohs(addr.sin_port);
+
+        epoll_fd = epoll_create1(0);
+        wake_fd = eventfd(0, EFD_NONBLOCK);
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.fd = listen_fd;
+        epoll_ctl(epoll_fd, EPOLL_CTL_ADD, listen_fd, &ev);
+        ev.events = EPOLLIN;
+        ev.data.fd = wake_fd;
+        epoll_ctl(epoll_fd, EPOLL_CTL_ADD, wake_fd, &ev);
+
+        running = true;
+        io_thread = std::thread([this] { loop(); });
+        return true;
+    }
+
+    void stop() {
+        if (!running.exchange(false)) return;
+        uint64_t one = 1;
+        ssize_t r = write(wake_fd, &one, sizeof(one));
+        (void)r;
+        q_cv.notify_all();
+        if (io_thread.joinable()) io_thread.join();
+        for (auto& [fd, c] : conns) close(fd);
+        conns.clear();
+        if (listen_fd >= 0) close(listen_fd);
+        if (epoll_fd >= 0) close(epoll_fd);
+        if (wake_fd >= 0) close(wake_fd);
+    }
+
+    // ---------------------------------------------------------- IO loop #
+
+    void loop() {
+        std::vector<epoll_event> events(256);
+        int64_t last_idle_check = now_ms();
+        while (running) {
+            int n = epoll_wait(epoll_fd, events.data(),
+                               static_cast<int>(events.size()), 1000);
+            if (!running) break;
+            for (int i = 0; i < n; i++) {
+                int fd = events[i].data.fd;
+                if (fd == listen_fd) {
+                    accept_new();
+                } else if (fd == wake_fd) {
+                    uint64_t tmp;
+                    while (read(wake_fd, &tmp, sizeof(tmp)) > 0) {
+                    }
+                    drain_responses();
+                } else {
+                    if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+                        drop_conn(fd);
+                        continue;
+                    }
+                    if (events[i].events & EPOLLIN) handle_read(fd);
+                    if (events[i].events & EPOLLOUT) handle_write(fd);
+                }
+            }
+            int64_t t = now_ms();
+            if (t - last_idle_check > 10000) {
+                last_idle_check = t;
+                std::vector<int> idle;
+                for (auto& [fd, c] : conns)
+                    if (t - c.last_activity_ms > IDLE_TIMEOUT_MS)
+                        idle.push_back(fd);
+                for (int fd : idle) drop_conn(fd);
+            }
+        }
+    }
+
+    void accept_new() {
+        for (;;) {
+            int fd = accept4(listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+            if (fd < 0) break;
+            int one = 1;
+            setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            Conn c;
+            c.fd = fd;
+            c.gen = next_gen++;
+            c.last_activity_ms = now_ms();
+            conns.emplace(fd, std::move(c));
+            epoll_event ev{};
+            // During a backpressure pause new connections must not arm
+            // EPOLLIN, or level-triggered epoll spins on their bytes.
+            ev.events = paused ? 0u : EPOLLIN;
+            ev.data.fd = fd;
+            epoll_ctl(epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+            n_conns++;
+        }
+    }
+
+    void drop_conn(int fd) {
+        auto it = conns.find(fd);
+        if (it == conns.end()) return;
+        epoll_ctl(epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
+        close(fd);
+        conns.erase(it);
+    }
+
+    void set_reading(bool enable) {
+        for (auto& [fd, c] : conns) {
+            epoll_event ev{};
+            ev.events = (enable ? EPOLLIN : 0u) |
+                        (c.want_write ? EPOLLOUT : 0u);
+            ev.data.fd = fd;
+            epoll_ctl(epoll_fd, EPOLL_CTL_MOD, fd, &ev);
+        }
+    }
+
+    bool over_cap() {
+        std::lock_guard<std::mutex> lk(q_mu);
+        return queue.size() >= queue_cap;
+    }
+
+    void handle_read(int fd) {
+        if (paused) return;
+        auto it = conns.find(fd);
+        if (it == conns.end()) return;
+        Conn& c = it->second;
+        char tmp[16384];
+        for (;;) {
+            ssize_t r = read(fd, tmp, sizeof(tmp));
+            if (r > 0) {
+                c.rbuf.append(tmp, r);
+                // Parse eagerly so a pipelining client never accumulates;
+                // the 64 KB cap applies to the unparseable residue (one
+                // oversized frame), matching the reference's incremental
+                // read-then-parse loop (redis/mod.rs:97-127).
+                process_buffer(c);
+                auto again = conns.find(fd);
+                if (again == conns.end() || &again->second != &c)
+                    return;  // dropped (or rehashed after an erase)
+                if (c.closing) return;
+                if (c.rbuf.size() > MAX_CONN_BUFFER) {
+                    send_raw(c, "-ERR request too large\r\n", true);
+                    return;
+                }
+                if (over_cap()) {
+                    paused = true;
+                    set_reading(false);
+                    return;
+                }
+            } else if (r == 0) {
+                drop_conn(fd);
+                return;
+            } else {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+                drop_conn(fd);
+                return;
+            }
+        }
+        c.last_activity_ms = now_ms();
+    }
+
+    void process_buffer(Conn& first) {
+        // dispatch/send_raw may drop the connection (QUIT, write error),
+        // destroying the Conn — re-resolve by fd + generation after every
+        // step instead of holding a reference across them.
+        const int fd = first.fd;
+        const uint64_t gen = first.gen;
+        bool enqueued = false;
+        for (;;) {
+            auto it = conns.find(fd);
+            if (it == conns.end() || it->second.gen != gen) break;
+            Conn& c = it->second;
+            if (c.rbuf.empty() || c.closing) break;
+            size_t consumed = 0;
+            std::vector<std::string> args;
+            std::string err;
+            int r = parse_command(c.rbuf, consumed, args, err);
+            if (r == 0) break;
+            if (r < 0) {
+                send_raw(c, "-" + err + "\r\n", true);
+                break;
+            }
+            c.rbuf.erase(0, consumed);
+            enqueued |= dispatch(c, args);
+        }
+        if (enqueued) q_cv.notify_one();
+    }
+
+    // Returns true if a THROTTLE landed in the pending queue.
+    bool dispatch(Conn& c, std::vector<std::string>& args) {
+        n_inline++;
+        if (args.empty()) {
+            send_raw(c, "-ERR empty command\r\n", false);
+            return false;
+        }
+        const std::string cmd = upper(args[0]);
+        if (cmd == "PING") {
+            if (args.size() == 1) {
+                send_raw(c, "+PONG\r\n", false);
+            } else if (args.size() == 2) {
+                char head[32];
+                int hn = snprintf(head, sizeof(head), "$%zu\r\n",
+                                  args[1].size());
+                send_raw(c, std::string(head, hn) + args[1] + "\r\n",
+                         false);
+            } else {
+                send_raw(
+                    c,
+                    "-ERR wrong number of arguments for 'ping' command\r\n",
+                    false);
+            }
+            return false;
+        }
+        if (cmd == "QUIT") {
+            send_raw(c, "+OK\r\n", true);
+            return false;
+        }
+        if (cmd != "THROTTLE") {
+            send_raw(c, "-ERR unknown command '" + cmd + "'\r\n", false);
+            return false;
+        }
+        if (args.size() < 5 || args.size() > 6) {
+            send_raw(
+                c,
+                "-ERR wrong number of arguments for 'throttle' "
+                "command\r\n",
+                false);
+            return false;
+        }
+        PendingRequest req;
+        req.conn_gen = c.gen;
+        req.fd = c.fd;
+        req.key = args[1];
+        if (!parse_i64_ascii(args[2], req.max_burst)) {
+            send_raw(c, "-ERR invalid max_burst\r\n", false);
+            return false;
+        }
+        if (!parse_i64_ascii(args[3], req.count_per_period)) {
+            send_raw(c, "-ERR invalid count_per_period\r\n", false);
+            return false;
+        }
+        if (!parse_i64_ascii(args[4], req.period)) {
+            send_raw(c, "-ERR invalid period\r\n", false);
+            return false;
+        }
+        req.quantity = 1;
+        if (args.size() == 6 &&
+            !parse_i64_ascii(args[5], req.quantity)) {
+            send_raw(c, "-ERR invalid quantity\r\n", false);
+            return false;
+        }
+        {
+            std::lock_guard<std::mutex> lk(q_mu);
+            queue.push_back(std::move(req));
+        }
+        n_requests++;
+        return true;
+    }
+
+    void send_raw(Conn& c, const std::string& data, bool then_close) {
+        c.wbuf += data;
+        if (then_close) c.closing = true;
+        flush(c);
+    }
+
+    void flush(Conn& c) {
+        while (!c.wbuf.empty()) {
+            ssize_t w = write(c.fd, c.wbuf.data(), c.wbuf.size());
+            if (w > 0) {
+                c.wbuf.erase(0, w);
+            } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+                break;
+            } else {
+                drop_conn(c.fd);
+                return;
+            }
+        }
+        bool want = !c.wbuf.empty();
+        if (want != c.want_write) {
+            c.want_write = want;
+            epoll_event ev{};
+            ev.events = EPOLLIN | (want ? EPOLLOUT : 0);
+            ev.data.fd = c.fd;
+            epoll_ctl(epoll_fd, EPOLL_CTL_MOD, c.fd, &ev);
+        }
+        if (c.wbuf.empty() && c.closing) drop_conn(c.fd);
+    }
+
+    void handle_write(int fd) {
+        auto it = conns.find(fd);
+        if (it != conns.end()) flush(it->second);
+    }
+
+    void drain_responses() {
+        if (paused) {
+            std::unique_lock<std::mutex> lk(q_mu);
+            if (queue.size() < queue_cap / 2) {
+                lk.unlock();
+                paused = false;
+                set_reading(true);
+            }
+        }
+        std::deque<std::pair<std::pair<uint64_t, int>, std::string>> local;
+        {
+            std::lock_guard<std::mutex> lk(r_mu);
+            local.swap(responses);
+        }
+        for (auto& [who, payload] : local) {
+            auto it = conns.find(who.second);
+            if (it == conns.end() || it->second.gen != who.first)
+                continue;  // connection died while the batch was in flight
+            it->second.wbuf += payload;
+            flush(it->second);
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ws_create() { return new WireServer(); }
+
+int ws_start(void* h, const char* host, uint16_t port) {
+    return static_cast<WireServer*>(h)->start(host, port) ? 0 : -1;
+}
+
+uint16_t ws_port(void* h) { return static_cast<WireServer*>(h)->port; }
+
+void ws_stop(void* h) { static_cast<WireServer*>(h)->stop(); }
+
+void ws_destroy(void* h) {
+    auto* s = static_cast<WireServer*>(h);
+    s->stop();
+    delete s;
+}
+
+// Blocks up to timeout_us for pending THROTTLE requests, then fills up to
+// max_n of them.  Key bytes are concatenated into key_buf (cap key_buf_len)
+// with offsets[n+1]; params land in the i64 arrays; cookies (conn gen+fd)
+// identify where the response goes.  Returns n (0 on timeout/shutdown).
+int64_t ws_next_batch(void* h, int64_t timeout_us, int64_t max_n,
+                      char* key_buf, int64_t key_buf_len, int64_t* offsets,
+                      int64_t* params /* [4 * max_n] interleaved */,
+                      uint64_t* cookie_gen, int32_t* cookie_fd) {
+    auto* s = static_cast<WireServer*>(h);
+    std::unique_lock<std::mutex> lk(s->q_mu);
+    if (s->queue.empty()) {
+        s->q_cv.wait_for(lk, std::chrono::microseconds(timeout_us), [&] {
+            return !s->queue.empty() || !s->running;
+        });
+    }
+    int64_t n = 0;
+    int64_t key_off = 0;
+    offsets[0] = 0;
+    while (n < max_n && !s->queue.empty()) {
+        PendingRequest& req = s->queue.front();
+        if (key_off + static_cast<int64_t>(req.key.size()) > key_buf_len) {
+            // Progress guarantee: the first request always ships (the
+            // caller sizes key_buf above the per-frame cap, so a single
+            // key can never exceed it) — a full buffer only defers the
+            // rest to the next call.
+            if (n > 0) break;
+            s->queue.pop_front();  // defensive: impossible oversized key
+            continue;
+        }
+        memcpy(key_buf + key_off, req.key.data(), req.key.size());
+        key_off += req.key.size();
+        offsets[n + 1] = key_off;
+        params[4 * n + 0] = req.max_burst;
+        params[4 * n + 1] = req.count_per_period;
+        params[4 * n + 2] = req.period;
+        params[4 * n + 3] = req.quantity;
+        cookie_gen[n] = req.conn_gen;
+        cookie_fd[n] = req.fd;
+        s->queue.pop_front();
+        n++;
+    }
+    return n;
+}
+
+// Complete n requests: results[5*i..] = (allowed, limit, remaining,
+// reset_after, retry_after) as i64 (already whole seconds), status[i] != 0
+// marks a validation failure mapped to the matching -ERR string.
+void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
+                const int32_t* cookie_fd, const int64_t* results,
+                const uint8_t* status) {
+    auto* s = static_cast<WireServer*>(h);
+    {
+        std::lock_guard<std::mutex> lk(s->r_mu);
+        for (int64_t i = 0; i < n; i++) {
+            std::string payload;
+            if (status[i] == 0) {
+                char buf[160];
+                int len = snprintf(
+                    buf, sizeof(buf),
+                    "*5\r\n:%lld\r\n:%lld\r\n:%lld\r\n:%lld\r\n:%lld\r\n",
+                    static_cast<long long>(results[5 * i + 0]),
+                    static_cast<long long>(results[5 * i + 1]),
+                    static_cast<long long>(results[5 * i + 2]),
+                    static_cast<long long>(results[5 * i + 3]),
+                    static_cast<long long>(results[5 * i + 4]));
+                payload.assign(buf, len);
+            } else if (status[i] == 1) {
+                payload = "-ERR quantity cannot be negative\r\n";
+            } else if (status[i] == 2) {
+                payload = "-ERR invalid rate limit parameters\r\n";
+            } else {
+                payload = "-ERR internal error\r\n";
+            }
+            s->responses.emplace_back(
+                std::make_pair(cookie_gen[i], cookie_fd[i]),
+                std::move(payload));
+        }
+    }
+    uint64_t one = 1;
+    ssize_t r = write(s->wake_fd, &one, sizeof(one));
+    (void)r;
+}
+
+void ws_stats(void* h, uint64_t* out_conns, uint64_t* out_requests,
+              uint64_t* out_commands) {
+    auto* s = static_cast<WireServer*>(h);
+    *out_conns = s->n_conns;
+    *out_requests = s->n_requests;
+    *out_commands = s->n_inline;
+}
+
+}  // extern "C"

@@ -26,19 +26,26 @@ _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
-def _build() -> Optional[ctypes.CDLL]:
-    global _build_error
-    _LIB.parent.mkdir(parents=True, exist_ok=True)
-    if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+def _compile(src: Path, out: Path, extra=()) -> Optional[str]:
+    """Build a shared library if stale; returns an error string or None."""
+    out.parent.mkdir(parents=True, exist_ok=True)
+    if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
         cmd = [
-            "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-            str(_SRC), "-o", str(_LIB),
+            "g++", "-O3", "-march=native", "-std=c++17", "-shared",
+            "-fPIC", str(src), "-o", str(out), *extra,
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         except (subprocess.SubprocessError, FileNotFoundError) as e:
-            _build_error = str(e)
-            return None
+            return str(e)
+    return None
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_error
+    _build_error = _compile(_SRC, _LIB)
+    if _build_error is not None:
+        return None
     lib = ctypes.CDLL(str(_LIB))
     lib.tk_create.restype = ctypes.c_void_p
     lib.tk_create.argtypes = [ctypes.c_int64]
@@ -74,6 +81,58 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return get_lib() is not None
+
+
+# ------------------------------------------------------------------ #
+# Wire-server library (native/wire_server.cpp): the C++ RESP front-end.
+
+_WS_SRC = _REPO_ROOT / "native" / "wire_server.cpp"
+_WS_LIB = _REPO_ROOT / "native" / "build" / "libtkwire.so"
+_ws_lib: Optional[ctypes.CDLL] = None
+_ws_error: Optional[str] = None
+
+
+def _build_wire() -> Optional[ctypes.CDLL]:
+    global _ws_error
+    _ws_error = _compile(_WS_SRC, _WS_LIB, extra=("-pthread",))
+    if _ws_error is not None:
+        return None
+    lib = ctypes.CDLL(str(_WS_LIB))
+    lib.ws_create.restype = ctypes.c_void_p
+    lib.ws_start.restype = ctypes.c_int
+    lib.ws_start.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,
+    ]
+    lib.ws_port.restype = ctypes.c_uint16
+    lib.ws_port.argtypes = [ctypes.c_void_p]
+    lib.ws_stop.argtypes = [ctypes.c_void_p]
+    lib.ws_destroy.argtypes = [ctypes.c_void_p]
+    lib.ws_next_batch.restype = ctypes.c_int64
+    lib.ws_next_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    lib.ws_respond.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.ws_stats.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    return lib
+
+
+def get_wire_lib() -> Optional[ctypes.CDLL]:
+    global _ws_lib
+    with _lock:
+        if _ws_lib is None and _ws_error is None:
+            _ws_lib = _build_wire()
+        return _ws_lib
+
+
+def wire_available() -> bool:
+    return get_wire_lib() is not None
 
 
 class NativeKeyMap:

@@ -45,13 +45,35 @@ def build_transports(config: Config, engine, metrics):
             GrpcTransport(config.grpc_host, config.grpc_port, engine, metrics)
         )
     if config.redis:
-        from .redis import RedisTransport
+        if config.redis_backend == "native":
+            from .native_redis import NativeRedisTransport
+            from .store import create_cleanup_policy
 
-        transports.append(
-            RedisTransport(
-                config.redis_host, config.redis_port, engine, metrics
+            # One policy instance is shared by the engine and the native
+            # driver (both consult it under engine.limiter_lock), so ops
+            # accounting sees all traffic and sweeps never double-fire.
+            native_policy = engine.cleanup_policy
+            transports.append(
+                NativeRedisTransport(
+                    config.redis_host,
+                    config.redis_port,
+                    engine.limiter,
+                    metrics,
+                    batch_size=config.batch_size,
+                    max_linger_us=config.max_linger_us,
+                    cleanup_policy=native_policy,
+                    limiter_lock=engine.limiter_lock,
+                    now_fn=engine.now_fn,
+                )
             )
-        )
+        else:
+            from .redis import RedisTransport
+
+            transports.append(
+                RedisTransport(
+                    config.redis_host, config.redis_port, engine, metrics
+                )
+            )
     return transports
 
 

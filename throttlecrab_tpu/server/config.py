@@ -34,6 +34,8 @@ _SPEC = [
      "Enable Redis protocol transport"),
     ("redis_host", "THROTTLECRAB_REDIS_HOST", "0.0.0.0", str, "Redis host"),
     ("redis_port", "THROTTLECRAB_REDIS_PORT", 6379, int, "Redis port"),
+    ("redis_backend", "THROTTLECRAB_REDIS_BACKEND", "python", str,
+     "Redis transport backend: python (asyncio) or native (C++ epoll)"),
     ("store", "THROTTLECRAB_STORE", "periodic", str,
      "Store type: periodic, probabilistic, adaptive"),
     ("store_capacity", "THROTTLECRAB_STORE_CAPACITY", 100_000, int,
@@ -80,6 +82,7 @@ class Config:
     redis: bool = False
     redis_host: str = "0.0.0.0"
     redis_port: int = 6379
+    redis_backend: str = "python"
     store: str = "periodic"
     store_capacity: int = 100_000
     store_cleanup_interval: int = 300
@@ -126,6 +129,11 @@ class Config:
             raise ConfigError("max_denied_keys must be in 0..=10000")
         if self.batch_size <= 0:
             raise ConfigError("batch_size must be positive")
+        if self.redis_backend not in ("python", "native"):
+            raise ConfigError(
+                f"Invalid redis backend: {self.redis_backend!r} "
+                "(expected python or native)"
+            )
         if self.keymap not in ("auto", "python", "native"):
             raise ConfigError(
                 f"Invalid keymap backend: {self.keymap!r} "

@@ -64,9 +64,13 @@ class BatchingEngine:
         """`limiter` is a TpuRateLimiter / ShardedTpuRateLimiter (or any
         object with rate_limit_batch + sweep).  `now_fn` injects time for
         tests (time is an input, never ambient — rate_limiter.rs:109)."""
+        import threading
         import time
 
         self.limiter = limiter
+        # Serializes device access with native transports that drive the
+        # same limiter from their own threads (server/native_redis.py).
+        self.limiter_lock = threading.Lock()
         self.batch_size = batch_size
         self.max_linger_s = max_linger_us / 1e6
         self.cleanup_policy = cleanup_policy
@@ -161,7 +165,7 @@ class BatchingEngine:
         def launch():
             from ..tpu.profiling import annotate
 
-            with annotate("gcra_scan_decide"):
+            with self.limiter_lock, annotate("gcra_scan_decide"):
                 return self.limiter.rate_limit_many(
                     [
                         (
@@ -203,7 +207,7 @@ class BatchingEngine:
         def launch():
             from ..tpu.profiling import annotate
 
-            with annotate("gcra_batch_decide"):
+            with self.limiter_lock, annotate("gcra_batch_decide"):
                 return self.limiter.rate_limit_batch(
                     [r.key for r in requests],
                     [r.max_burst for r in requests],
@@ -272,15 +276,24 @@ class BatchingEngine:
         policy = self.cleanup_policy
         if policy is None:
             return
-        policy.record_ops(n_ops)
-        live = len(self.limiter)
-        capacity = getattr(self.limiter, "total_capacity", 1 << 62)
-        if policy.should_clean(now_ns, live, capacity):
+        # The policy instance may be shared with a native transport's
+        # driver thread (server/native_redis.py): all policy state moves
+        # under limiter_lock.
+        with self.limiter_lock:
+            policy.record_ops(n_ops)
+            live = len(self.limiter)
+            capacity = getattr(self.limiter, "total_capacity", 1 << 62)
+            should = policy.should_clean(now_ns, live, capacity)
+        if should:
             loop = asyncio.get_running_loop()
-            freed = await loop.run_in_executor(
-                None, self.limiter.sweep, now_ns
-            )
-            policy.after_sweep(now_ns, freed, live)
+
+            def locked_sweep():
+                with self.limiter_lock:
+                    freed = self.limiter.sweep(now_ns)
+                    policy.after_sweep(now_ns, freed, live)
+                    return freed
+
+            freed = await loop.run_in_executor(None, locked_sweep)
             if self.metrics is not None:
                 self.metrics.record_sweep(freed)
 

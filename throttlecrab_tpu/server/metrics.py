@@ -60,6 +60,11 @@ class Metrics:
     """Request counters + optional top-denied-keys tracking."""
 
     def __init__(self, max_denied_keys: int = 0) -> None:
+        import threading
+
+        # Guards every counter update: the event loop and native driver
+        # threads both write here, and Python's `x += n` is not atomic.
+        self._lock = threading.Lock()
         self.start_time = time.time()
         self.requests_total = 0
         self.requests_by_transport: Dict[str, int] = {
@@ -88,13 +93,14 @@ class Metrics:
     # ------------------------------------------------------------------ #
 
     def record_request(self, transport: str, allowed: bool) -> None:
-        self.requests_total += 1
-        if transport in self.requests_by_transport:
-            self.requests_by_transport[transport] += 1
-        if allowed:
-            self.requests_allowed += 1
-        else:
-            self.requests_denied += 1
+        with self._lock:
+            self.requests_total += 1
+            if transport in self.requests_by_transport:
+                self.requests_by_transport[transport] += 1
+            if allowed:
+                self.requests_allowed += 1
+            else:
+                self.requests_denied += 1
 
     def record_request_with_key(
         self, transport: str, allowed: bool, key: str
@@ -102,13 +108,35 @@ class Metrics:
         """metrics.rs:162-173: denied keys feed the leaderboard."""
         self.record_request(transport, allowed)
         if not allowed and self.top_denied is not None:
-            self.top_denied.record(key)
+            with self._lock:
+                self.top_denied.record(key)
 
     def record_error(self, transport: str) -> None:
-        self.requests_total += 1
-        if transport in self.requests_by_transport:
-            self.requests_by_transport[transport] += 1
-        self.requests_errors += 1
+        with self._lock:
+            self.requests_total += 1
+            if transport in self.requests_by_transport:
+                self.requests_by_transport[transport] += 1
+            self.requests_errors += 1
+
+    def record_batch(
+        self, transport, n_allowed, n_denied, n_errors, denied_keys, batch
+    ) -> None:
+        """One aggregated update per device launch (thread-safe: native
+        transports drive from their own threads)."""
+        with self._lock:
+            n = n_allowed + n_denied + n_errors
+            self.requests_total += n
+            if transport in self.requests_by_transport:
+                self.requests_by_transport[transport] += n
+            self.requests_allowed += n_allowed
+            self.requests_denied += n_denied
+            self.requests_errors += n_errors
+            if self.top_denied is not None:
+                for key in denied_keys:
+                    self.top_denied.record(key)
+            self.device_launches += 1
+            self.batched_requests += batch
+            self.max_batch = max(self.max_batch, batch)
 
     def record_launch(self, batch_size: int) -> None:
         self.device_launches += 1

@@ -1,0 +1,229 @@
+"""Native RESP transport: C++ epoll wire layer + Python device driver.
+
+The C++ side (native/wire_server.cpp) owns the sockets: accept, RESP
+parsing, PING/QUIT and protocol errors answered inline, THROTTLE requests
+queued.  This module runs the *driver thread*: it blocks in
+`ws_next_batch` (releasing the GIL), decides the batch on the device, and
+hands the 5-integer results back to C++ for serialization — so the wire
+path's per-request Python cost is zero, and the per-batch Python cost is
+one `rate_limit_batch` call.
+
+Same command semantics and hardening as the asyncio transport (redis.py)
+and the reference (redis/mod.rs); the two are interchangeable via
+`--redis-backend {python,native}`.
+
+Shared state: pass the same limiter (and `limiter_lock`) used by the
+asyncio engine so limits hold across every transport; the lock serializes
+device access between the engine's executor thread and this driver.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..native import get_wire_lib
+from ..tpu.limiter import STATUS_INTERNAL
+
+log = logging.getLogger("throttlecrab.redis.native")
+
+NS_PER_SEC = 1_000_000_000
+
+
+class NativeRedisTransport:
+    """RESP on the C++ wire server; drop-in for RedisTransport."""
+
+    name = "redis"
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        limiter,
+        metrics,
+        batch_size: int = 4096,
+        max_linger_us: int = 200,
+        cleanup_policy=None,
+        limiter_lock: Optional[threading.Lock] = None,
+        now_fn=None,
+    ) -> None:
+        lib = get_wire_lib()
+        if lib is None:
+            raise RuntimeError("native wire server unavailable (no g++?)")
+        self._lib = lib
+        self.host = host
+        self.port = port
+        self.limiter = limiter
+        self.metrics = metrics
+        self.batch_size = batch_size
+        self.max_linger_us = max_linger_us
+        self.cleanup_policy = cleanup_policy
+        self.limiter_lock = limiter_lock or threading.Lock()
+        self.now_fn = now_fn or time.time_ns
+        self._h = lib.ws_create()
+        self._driver: Optional[threading.Thread] = None
+        self._running = False
+        self.bound_port: Optional[int] = None
+        # Reusable batch buffers.  key_buf must exceed the wire layer's
+        # per-connection frame cap (64 KB) so any single accepted key fits
+        # — ws_next_batch's progress guarantee depends on it.
+        B = batch_size
+        self._key_buf = ctypes.create_string_buffer(B * 256 + (128 << 10))
+        self._offsets = np.zeros(B + 1, np.int64)
+        self._params = np.zeros(4 * B, np.int64)
+        self._cookie_gen = np.zeros(B, np.uint64)
+        self._cookie_fd = np.zeros(B, np.int32)
+
+    # ------------------------------------------------------------------ #
+
+    async def start(self) -> None:
+        rc = self._lib.ws_start(self._h, self.host.encode(), self.port)
+        if rc != 0:
+            raise OSError(
+                f"native redis transport failed to bind {self.host}:"
+                f"{self.port}"
+            )
+        self.bound_port = self._lib.ws_port(self._h)
+        self._running = True
+        self._driver = threading.Thread(
+            target=self._drive, name="tk-native-redis", daemon=True
+        )
+        self._driver.start()
+        log.info(
+            "native Redis transport listening on %s:%d",
+            self.host, self.bound_port,
+        )
+
+    async def serve_forever(self) -> None:
+        import asyncio
+
+        while self._running:
+            await asyncio.sleep(0.5)
+            if self._driver is not None and not self._driver.is_alive():
+                raise RuntimeError("native redis driver thread died")
+
+    async def stop(self) -> None:
+        self._running = False
+        self._lib.ws_stop(self._h)
+        if self._driver is not None:
+            self._driver.join(timeout=5)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.ws_destroy(h)
+            self._h = None
+
+    # ------------------------------------------------------------------ #
+
+    def _drive(self) -> None:
+        """The decide loop: block for a batch, decide, respond."""
+        B = self.batch_size
+        while self._running:
+            try:
+                n = self._lib.ws_next_batch(
+                    self._h,
+                    self.max_linger_us,
+                    B,
+                    self._key_buf,
+                    len(self._key_buf),
+                    self._offsets.ctypes.data_as(ctypes.c_void_p),
+                    self._params.ctypes.data_as(ctypes.c_void_p),
+                    self._cookie_gen.ctypes.data_as(ctypes.c_void_p),
+                    self._cookie_fd.ctypes.data_as(ctypes.c_void_p),
+                )
+                if n <= 0:
+                    continue
+                self._decide(int(n))
+            except Exception:
+                log.exception("native redis driver error")
+                if not self._running:
+                    return
+
+    def _decide(self, n: int) -> None:
+        offsets = self._offsets
+        # Copy only the used prefix, not the whole reusable buffer.
+        blob = ctypes.string_at(self._key_buf, int(offsets[n]))
+        keys = [
+            blob[offsets[i] : offsets[i + 1]] for i in range(n)
+        ]
+        if not getattr(self.limiter.keymap, "BYTES_KEYS", False):
+            # Match the identity the str-keyed transports use, so one
+            # client key maps to one bucket across HTTP/gRPC/RESP.
+            # surrogateescape keeps arbitrary bytes unique and lossless.
+            keys = [k.decode("utf-8", "surrogateescape") for k in keys]
+        p = self._params
+        now_ns = self.now_fn()
+        results = np.zeros(5 * n, np.int64)
+        try:
+            with self.limiter_lock:
+                res = self.limiter.rate_limit_batch(
+                    keys,
+                    p[0 : 4 * n : 4],
+                    p[1 : 4 * n : 4],
+                    p[2 : 4 * n : 4],
+                    p[3 : 4 * n : 4],
+                    now_ns,
+                )
+            status = np.ascontiguousarray(res.status, np.uint8)
+            out = results.reshape(n, 5)
+            out[:, 0] = res.allowed
+            out[:, 1] = res.limit
+            out[:, 2] = res.remaining
+            out[:, 3] = res.reset_after_ns // NS_PER_SEC
+            out[:, 4] = res.retry_after_ns // NS_PER_SEC
+        except Exception:
+            log.exception("native redis decide failed")
+            status = np.full(n, STATUS_INTERNAL, np.uint8)
+        self._lib.ws_respond(
+            self._h,
+            n,
+            self._cookie_gen.ctypes.data_as(ctypes.c_void_p),
+            self._cookie_fd.ctypes.data_as(ctypes.c_void_p),
+            results.ctypes.data_as(ctypes.c_void_p),
+            status.ctypes.data_as(ctypes.c_void_p),
+        )
+        if self.metrics is not None:
+            ok = status == 0
+            allowed_mask = results.reshape(n, 5)[:, 0] != 0
+            if self.metrics.top_denied is not None:
+                denied_keys = [
+                    k.decode("utf-8", "replace") if isinstance(k, bytes)
+                    else k
+                    for k in (
+                        keys[i] for i in np.flatnonzero(~allowed_mask & ok)
+                    )
+                ]
+            else:
+                denied_keys = ()
+            self.metrics.record_batch(
+                self.name,
+                n_allowed=int((allowed_mask & ok).sum()),
+                n_denied=int((~allowed_mask & ok).sum()),
+                n_errors=int((~ok).sum()),
+                denied_keys=denied_keys,
+                batch=n,
+            )
+        self._maybe_sweep(now_ns, n)
+
+    def _maybe_sweep(self, now_ns: int, n_ops: int) -> None:
+        """Policy state is shared with the asyncio engine — all policy
+        interaction happens under limiter_lock (see engine._maybe_sweep)."""
+        policy = self.cleanup_policy
+        if policy is None:
+            return
+        with self.limiter_lock:
+            policy.record_ops(n_ops)
+            live = len(self.limiter)
+            capacity = getattr(self.limiter, "total_capacity", 1 << 62)
+            if not policy.should_clean(now_ns, live, capacity):
+                return
+            freed = self.limiter.sweep(now_ns)
+            policy.after_sweep(now_ns, freed, live)
+        if self.metrics is not None:
+            self.metrics.record_sweep(freed)
