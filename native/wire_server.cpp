@@ -60,8 +60,22 @@ int64_t now_ms() {
 struct PendingRequest {
     uint64_t conn_gen;   // connection generation cookie
     int fd;
+    uint64_t slot_seq;   // position in the connection's response order
     std::string key;
     int64_t max_burst, count_per_period, period, quantity;
+    bool keep_alive = true;  // HTTP only: close after responding if false
+};
+
+// One entry per request in a connection's response order.  Inline replies
+// (PING, QUIT, errors, /health, 404) are born ready; THROTTLE slots fill
+// when the driver responds.  The writer only ever flushes the ready
+// prefix, so pipelined responses leave in exactly request order — the
+// property RESP and HTTP/1.1 both require and the asyncio backends get
+// for free from their sequential loops.
+struct Slot {
+    bool ready = false;
+    bool close_after = false;
+    std::string payload;
 };
 
 struct Conn {
@@ -159,11 +173,117 @@ std::string upper(const std::string& s) {
     return o;
 }
 
+// Minimal JSON field extraction for the fixed /throttle schema
+// (http.rs:61-73).  Scans for "name" outside strings; handles \-escapes in
+// the key string; numbers are plain integers.
+bool json_find(const std::string& body, const char* name, size_t& val_pos) {
+    std::string pat = std::string("\"") + name + "\"";
+    size_t pos = 0;
+    bool in_str = false;
+    for (size_t i = 0; i < body.size(); i++) {
+        char ch = body[i];
+        if (in_str) {
+            if (ch == '\\') i++;
+            else if (ch == '"') in_str = false;
+            continue;
+        }
+        if (ch == '"') {
+            if (body.compare(i, pat.size(), pat) == 0) {
+                pos = i + pat.size();
+                while (pos < body.size() &&
+                       (body[pos] == ' ' || body[pos] == '\t'))
+                    pos++;
+                if (pos < body.size() && body[pos] == ':') {
+                    pos++;
+                    while (pos < body.size() &&
+                           (body[pos] == ' ' || body[pos] == '\t'))
+                        pos++;
+                    val_pos = pos;
+                    return true;
+                }
+            }
+            in_str = true;
+        }
+    }
+    return false;
+}
+
+bool json_int(const std::string& body, const char* name, int64_t& out) {
+    size_t pos;
+    if (!json_find(body, name, pos)) return false;
+    size_t end = pos;
+    if (end < body.size() && (body[end] == '-' || body[end] == '+')) end++;
+    while (end < body.size() && body[end] >= '0' && body[end] <= '9') end++;
+    if (end == pos) return false;
+    return parse_i64_ascii(body.substr(pos, end - pos), out);
+}
+
+bool json_string(const std::string& body, const char* name,
+                 std::string& out) {
+    size_t pos;
+    if (!json_find(body, name, pos)) return false;
+    if (pos >= body.size() || body[pos] != '"') return false;
+    pos++;
+    out.clear();
+    while (pos < body.size() && body[pos] != '"') {
+        char ch = body[pos];
+        if (ch == '\\' && pos + 1 < body.size()) {
+            char esc = body[pos + 1];
+            switch (esc) {
+                case 'n': out += '\n'; break;
+                case 't': out += '\t'; break;
+                case 'r': out += '\r'; break;
+                case 'b': out += '\b'; break;
+                case 'f': out += '\f'; break;
+                case 'u': {
+                    // \uXXXX → UTF-8 (BMP only; surrogate pairs are rare
+                    // in rate-limit keys and fall back to replacement).
+                    if (pos + 5 < body.size()) {
+                        unsigned cp = 0;
+                        bool ok = true;
+                        for (int k = 2; k <= 5; k++) {
+                            char h = body[pos + k];
+                            cp <<= 4;
+                            if (h >= '0' && h <= '9') cp |= h - '0';
+                            else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+                            else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+                            else { ok = false; break; }
+                        }
+                        if (ok) {
+                            if (cp < 0x80) out += static_cast<char>(cp);
+                            else if (cp < 0x800) {
+                                out += static_cast<char>(0xC0 | (cp >> 6));
+                                out += static_cast<char>(0x80 | (cp & 0x3F));
+                            } else {
+                                out += static_cast<char>(0xE0 | (cp >> 12));
+                                out += static_cast<char>(
+                                    0x80 | ((cp >> 6) & 0x3F));
+                                out += static_cast<char>(0x80 | (cp & 0x3F));
+                            }
+                            pos += 6;
+                            continue;
+                        }
+                    }
+                    out += '?';
+                    break;
+                }
+                default: out += esc; break;
+            }
+            pos += 2;
+            continue;
+        }
+        out += ch;
+        pos++;
+    }
+    return pos < body.size();
+}
+
 struct WireServer {
     int listen_fd = -1;
     int epoll_fd = -1;
     int wake_fd = -1;   // responder → IO thread
     uint16_t port = 0;
+    int protocol = 0;   // 0 = RESP, 1 = HTTP/JSON
     std::thread io_thread;
     std::atomic<bool> running{false};
 
@@ -180,14 +300,25 @@ struct WireServer {
     size_t queue_cap = 100000;
     bool paused = false;
 
+    // Response routing: metas FIFO-paired with queue pops (see Inflight).
+    std::deque<Inflight> inflight;  // guarded by q_mu
+
     // driver → IO thread (serialized responses per conn).
     std::mutex r_mu;
     std::deque<std::pair<std::pair<uint64_t, int>, std::string>> responses;
+    // Conns to close once their queued response drains (HTTP
+    // Connection: close).
+    std::deque<std::pair<uint64_t, int>> close_marks;
+
+    // /metrics snapshot pushed by the driver (HTTP protocol only).
+    std::mutex m_mu;
+    std::string metrics_text;
 
     // stats
     std::atomic<uint64_t> n_conns{0}, n_requests{0}, n_inline{0};
 
-    bool start(const char* host, uint16_t want_port) {
+    bool start(const char* host, uint16_t want_port, int proto) {
+        protocol = proto;
         listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
         if (listen_fd < 0) return false;
         int one = 1;
@@ -369,6 +500,12 @@ struct WireServer {
             if (it == conns.end() || it->second.gen != gen) break;
             Conn& c = it->second;
             if (c.rbuf.empty() || c.closing) break;
+            if (protocol == 1) {
+                int r = step_http(c);
+                if (r == 0) break;
+                enqueued |= r > 1;
+                continue;
+            }
             size_t consumed = 0;
             std::vector<std::string> args;
             std::string err;
@@ -382,6 +519,123 @@ struct WireServer {
             enqueued |= dispatch(c, args);
         }
         if (enqueued) q_cv.notify_one();
+    }
+
+    // ------------------------------------------------------------ HTTP #
+
+    void send_http(Conn& c, int status, const char* content_type,
+                   const std::string& body, bool keep_alive) {
+        const char* reason =
+            status == 200 ? "OK"
+            : status == 400 ? "Bad Request"
+            : status == 404 ? "Not Found"
+            : "Internal Server Error";
+        char head[256];
+        int hn = snprintf(head, sizeof(head),
+                          "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                          "Content-Length: %zu\r\nConnection: %s\r\n\r\n",
+                          status, reason, content_type, body.size(),
+                          keep_alive ? "keep-alive" : "close");
+        send_raw(c, std::string(head, hn) + body, !keep_alive);
+    }
+
+    // Returns 0 = need more data, 1 = handled inline, 2 = enqueued.
+    int step_http(Conn& c) {
+        size_t head_end = c.rbuf.find("\r\n\r\n");
+        if (head_end == std::string::npos) return 0;
+        std::string head = c.rbuf.substr(0, head_end);
+        size_t line_end = head.find("\r\n");
+        std::string request_line =
+            head.substr(0, line_end == std::string::npos ? head.size()
+                                                         : line_end);
+        size_t sp1 = request_line.find(' ');
+        size_t sp2 = request_line.find(' ', sp1 + 1);
+        if (sp1 == std::string::npos || sp2 == std::string::npos) {
+            send_http(c, 400, "text/plain", "bad request line", false);
+            return 1;
+        }
+        std::string method = request_line.substr(0, sp1);
+        std::string path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+
+        // Headers we care about: content-length, connection.
+        int64_t content_length = 0;
+        bool keep_alive = true;
+        size_t pos = line_end == std::string::npos ? head.size()
+                                                   : line_end + 2;
+        while (pos < head.size()) {
+            size_t eol = head.find("\r\n", pos);
+            if (eol == std::string::npos) eol = head.size();
+            std::string line = head.substr(pos, eol - pos);
+            pos = eol + 2;
+            size_t colon = line.find(':');
+            if (colon == std::string::npos) continue;
+            std::string name = upper(line.substr(0, colon));
+            std::string value = line.substr(colon + 1);
+            while (!value.empty() && (value.front() == ' '))
+                value.erase(0, 1);
+            if (name == "CONTENT-LENGTH") {
+                if (!parse_i64_ascii(value, content_length) ||
+                    content_length < 0 ||
+                    content_length >
+                        static_cast<int64_t>(MAX_CONN_BUFFER)) {
+                    send_http(c, 400, "text/plain", "bad content-length",
+                              false);
+                    return 1;
+                }
+            } else if (name == "CONNECTION") {
+                keep_alive = upper(value) != "CLOSE";
+            }
+        }
+        size_t total = head_end + 4 + content_length;
+        if (c.rbuf.size() < total) return 0;
+        std::string body = c.rbuf.substr(head_end + 4, content_length);
+        c.rbuf.erase(0, total);
+
+        if (method == "GET" && path == "/health") {
+            send_http(c, 200, "text/plain", "OK", keep_alive);
+            return 1;
+        }
+        if (method == "GET" && path == "/metrics") {
+            std::string text;
+            {
+                std::lock_guard<std::mutex> lk(m_mu);
+                text = metrics_text;
+            }
+            send_http(c, 200, "text/plain; version=0.0.4", text,
+                      keep_alive);
+            return 1;
+        }
+        if (!(method == "POST" && path == "/throttle")) {
+            send_http(c, 404, "text/plain", "Not Found", keep_alive);
+            return 1;
+        }
+
+        PendingRequest req;
+        req.conn_gen = c.gen;
+        req.fd = c.fd;
+        req.keep_alive = keep_alive;
+        if (!json_string(body, "key", req.key)) {
+            send_http(c, 400, "application/json",
+                      "{\"error\": \"invalid request: missing key\"}",
+                      keep_alive);
+            return 1;
+        }
+        if (!json_int(body, "max_burst", req.max_burst) ||
+            !json_int(body, "count_per_period", req.count_per_period) ||
+            !json_int(body, "period", req.period)) {
+            send_http(c, 400, "application/json",
+                      "{\"error\": \"invalid request: missing field\"}",
+                      keep_alive);
+            return 1;
+        }
+        if (!json_int(body, "quantity", req.quantity))
+            req.quantity = 1;  // http.rs:135
+        {
+            std::lock_guard<std::mutex> lk(q_mu);
+            queue.push_back(std::move(req));
+        }
+        n_requests++;
+        return 2;
     }
 
     // Returns true if a THROTTLE landed in the pending queue.
@@ -499,16 +753,29 @@ struct WireServer {
             }
         }
         std::deque<std::pair<std::pair<uint64_t, int>, std::string>> local;
+        std::deque<std::pair<uint64_t, int>> closes;
         {
             std::lock_guard<std::mutex> lk(r_mu);
             local.swap(responses);
+            closes.swap(close_marks);
         }
         for (auto& [who, payload] : local) {
             auto it = conns.find(who.second);
             if (it == conns.end() || it->second.gen != who.first)
                 continue;  // connection died while the batch was in flight
             it->second.wbuf += payload;
-            flush(it->second);
+        }
+        for (auto& who : closes) {
+            auto it = conns.find(who.second);
+            if (it != conns.end() && it->second.gen == who.first)
+                it->second.closing = true;
+        }
+        // Flush after all appends so pipelined responses coalesce into
+        // fewer writes per connection.
+        for (auto& [who, payload] : local) {
+            auto it = conns.find(who.second);
+            if (it != conns.end() && it->second.gen == who.first)
+                flush(it->second);
         }
     }
 };
@@ -519,8 +786,16 @@ extern "C" {
 
 void* ws_create() { return new WireServer(); }
 
-int ws_start(void* h, const char* host, uint16_t port) {
-    return static_cast<WireServer*>(h)->start(host, port) ? 0 : -1;
+// protocol: 0 = RESP, 1 = HTTP/JSON.
+int ws_start(void* h, const char* host, uint16_t port, int protocol) {
+    return static_cast<WireServer*>(h)->start(host, port, protocol) ? 0 : -1;
+}
+
+// Push a fresh Prometheus snapshot for GET /metrics (HTTP protocol).
+void ws_set_metrics(void* h, const char* text, int64_t len) {
+    auto* s = static_cast<WireServer*>(h);
+    std::lock_guard<std::mutex> lk(s->m_mu);
+    s->metrics_text.assign(text, len);
 }
 
 uint16_t ws_port(void* h) { return static_cast<WireServer*>(h)->port; }
@@ -571,6 +846,7 @@ int64_t ws_next_batch(void* h, int64_t timeout_us, int64_t max_n,
         params[4 * n + 3] = req.quantity;
         cookie_gen[n] = req.conn_gen;
         cookie_fd[n] = req.fd;
+        s->inflight.push_back({req.conn_gen, req.fd, req.keep_alive});
         s->queue.pop_front();
         n++;
     }
@@ -584,11 +860,60 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                 const int32_t* cookie_fd, const int64_t* results,
                 const uint8_t* status) {
     auto* s = static_cast<WireServer*>(h);
+    std::deque<Inflight> metas;
+    {
+        std::lock_guard<std::mutex> lk(s->q_mu);
+        for (int64_t i = 0; i < n && !s->inflight.empty(); i++) {
+            metas.push_back(s->inflight.front());
+            s->inflight.pop_front();
+        }
+    }
     {
         std::lock_guard<std::mutex> lk(s->r_mu);
         for (int64_t i = 0; i < n; i++) {
+            const Inflight meta = i < static_cast<int64_t>(metas.size())
+                                      ? metas[i]
+                                      : Inflight{cookie_gen[i],
+                                                 cookie_fd[i], true};
             std::string payload;
-            if (status[i] == 0) {
+            if (s->protocol == 1) {
+                std::string body;
+                int code = 200;
+                if (status[i] == 0) {
+                    char buf[224];
+                    int len = snprintf(
+                        buf, sizeof(buf),
+                        "{\"allowed\": %s, \"limit\": %lld, "
+                        "\"remaining\": %lld, \"reset_after\": %lld, "
+                        "\"retry_after\": %lld}",
+                        results[5 * i + 0] ? "true" : "false",
+                        static_cast<long long>(results[5 * i + 1]),
+                        static_cast<long long>(results[5 * i + 2]),
+                        static_cast<long long>(results[5 * i + 3]),
+                        static_cast<long long>(results[5 * i + 4]));
+                    body.assign(buf, len);
+                } else {
+                    code = 500;  // engine-level error (http.rs:148-157)
+                    body = status[i] == 1
+                               ? "{\"error\": \"quantity cannot be "
+                                 "negative\"}"
+                           : status[i] == 2
+                               ? "{\"error\": \"invalid rate limit "
+                                 "parameters\"}"
+                               : "{\"error\": \"internal error\"}";
+                }
+                const char* reason =
+                    code == 200 ? "OK" : "Internal Server Error";
+                char head[224];
+                int hn = snprintf(
+                    head, sizeof(head),
+                    "HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
+                    "Content-Length: %zu\r\nConnection: %s\r\n\r\n",
+                    code, reason, body.size(),
+                    meta.keep_alive ? "keep-alive" : "close");
+                payload.assign(head, hn);
+                payload += body;
+            } else if (status[i] == 0) {
                 char buf[160];
                 int len = snprintf(
                     buf, sizeof(buf),
@@ -607,8 +932,10 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                 payload = "-ERR internal error\r\n";
             }
             s->responses.emplace_back(
-                std::make_pair(cookie_gen[i], cookie_fd[i]),
+                std::make_pair(meta.conn_gen, meta.fd),
                 std::move(payload));
+            if (s->protocol == 1 && !meta.keep_alive)
+                s->close_marks.emplace_back(meta.conn_gen, meta.fd);
         }
     }
     uint64_t one = 1;

@@ -101,7 +101,10 @@ def _build_wire() -> Optional[ctypes.CDLL]:
     lib.ws_create.restype = ctypes.c_void_p
     lib.ws_start.restype = ctypes.c_int
     lib.ws_start.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int,
+    ]
+    lib.ws_set_metrics.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
     ]
     lib.ws_port.restype = ctypes.c_uint16
     lib.ws_port.argtypes = [ctypes.c_void_p]

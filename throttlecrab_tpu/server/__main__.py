@@ -33,11 +33,30 @@ def build_transports(config: Config, engine, metrics):
     """One instance per enabled transport (main.rs:74-116)."""
     transports = []
     if config.http:
-        from .http import HttpTransport
+        if config.http_backend == "native":
+            from .native_http import NativeHttpTransport
 
-        transports.append(
-            HttpTransport(config.http_host, config.http_port, engine, metrics)
-        )
+            transports.append(
+                NativeHttpTransport(
+                    config.http_host,
+                    config.http_port,
+                    engine.limiter,
+                    metrics,
+                    batch_size=config.batch_size,
+                    max_linger_us=config.max_linger_us,
+                    cleanup_policy=engine.cleanup_policy,
+                    limiter_lock=engine.limiter_lock,
+                    now_fn=engine.now_fn,
+                )
+            )
+        else:
+            from .http import HttpTransport
+
+            transports.append(
+                HttpTransport(
+                    config.http_host, config.http_port, engine, metrics
+                )
+            )
     if config.grpc:
         from .grpc import GrpcTransport
 

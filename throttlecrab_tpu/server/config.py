@@ -27,6 +27,8 @@ _SPEC = [
     ("http", "THROTTLECRAB_HTTP", False, bool, "Enable HTTP transport"),
     ("http_host", "THROTTLECRAB_HTTP_HOST", "0.0.0.0", str, "HTTP host"),
     ("http_port", "THROTTLECRAB_HTTP_PORT", 8080, int, "HTTP port"),
+    ("http_backend", "THROTTLECRAB_HTTP_BACKEND", "python", str,
+     "HTTP transport backend: python (asyncio) or native (C++ epoll)"),
     ("grpc", "THROTTLECRAB_GRPC", False, bool, "Enable gRPC transport"),
     ("grpc_host", "THROTTLECRAB_GRPC_HOST", "0.0.0.0", str, "gRPC host"),
     ("grpc_port", "THROTTLECRAB_GRPC_PORT", 8070, int, "gRPC port"),
@@ -76,6 +78,7 @@ class Config:
     http: bool = False
     http_host: str = "0.0.0.0"
     http_port: int = 8080
+    http_backend: str = "python"
     grpc: bool = False
     grpc_host: str = "0.0.0.0"
     grpc_port: int = 8070
@@ -132,6 +135,11 @@ class Config:
         if self.redis_backend not in ("python", "native"):
             raise ConfigError(
                 f"Invalid redis backend: {self.redis_backend!r} "
+                "(expected python or native)"
+            )
+        if self.http_backend not in ("python", "native"):
+            raise ConfigError(
+                f"Invalid http backend: {self.http_backend!r} "
                 "(expected python or native)"
             )
         if self.keymap not in ("auto", "python", "native"):

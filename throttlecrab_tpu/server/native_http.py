@@ -1,0 +1,20 @@
+"""Native HTTP/JSON transport: the C++ epoll wire layer speaking HTTP.
+
+Identical driver architecture to the native RESP backend
+(native_redis.py); the C++ side parses `POST /throttle` JSON bodies,
+answers `GET /health` inline and serves `GET /metrics` from a snapshot the
+driver refreshes every second.  Wire schema matches the reference's axum
+routes (`http.rs:61-163`): quantity defaults to 1, server-side timestamps,
+engine errors as 500 `{"error": ...}`.
+
+Selectable via `--http-backend native`.
+"""
+
+from __future__ import annotations
+
+from .native_redis import NativeRedisTransport
+
+
+class NativeHttpTransport(NativeRedisTransport):
+    name = "http"
+    PROTOCOL = 1

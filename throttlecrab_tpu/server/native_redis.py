@@ -39,6 +39,7 @@ class NativeRedisTransport:
     """RESP on the C++ wire server; drop-in for RedisTransport."""
 
     name = "redis"
+    PROTOCOL = 0  # wire_server.cpp: 0 = RESP, 1 = HTTP
 
     def __init__(
         self,
@@ -82,21 +83,23 @@ class NativeRedisTransport:
     # ------------------------------------------------------------------ #
 
     async def start(self) -> None:
-        rc = self._lib.ws_start(self._h, self.host.encode(), self.port)
+        rc = self._lib.ws_start(
+            self._h, self.host.encode(), self.port, self.PROTOCOL
+        )
         if rc != 0:
             raise OSError(
-                f"native redis transport failed to bind {self.host}:"
+                f"native {self.name} transport failed to bind {self.host}:"
                 f"{self.port}"
             )
         self.bound_port = self._lib.ws_port(self._h)
         self._running = True
         self._driver = threading.Thread(
-            target=self._drive, name="tk-native-redis", daemon=True
+            target=self._drive, name=f"tk-native-{self.name}", daemon=True
         )
         self._driver.start()
         log.info(
-            "native Redis transport listening on %s:%d",
-            self.host, self.bound_port,
+            "native %s transport listening on %s:%d",
+            self.name, self.host, self.bound_port,
         )
 
     async def serve_forever(self) -> None:
@@ -124,8 +127,16 @@ class NativeRedisTransport:
     def _drive(self) -> None:
         """The decide loop: block for a batch, decide, respond."""
         B = self.batch_size
+        self._push_metrics()
+        last_metrics = time.monotonic()
         while self._running:
             try:
+                if (
+                    self.PROTOCOL == 1
+                    and time.monotonic() - last_metrics > 1.0
+                ):
+                    self._push_metrics()
+                    last_metrics = time.monotonic()
                 n = self._lib.ws_next_batch(
                     self._h,
                     self.max_linger_us,
@@ -210,6 +221,14 @@ class NativeRedisTransport:
                 batch=n,
             )
         self._maybe_sweep(now_ns, n)
+
+    def _push_metrics(self) -> None:
+        """GET /metrics is served from this snapshot (HTTP protocol; the
+        wire layer answers scrapes without a Python round-trip)."""
+        if self.PROTOCOL != 1 or self.metrics is None:
+            return
+        text = self.metrics.export_prometheus().encode()
+        self._lib.ws_set_metrics(self._h, text, len(text))
 
     def _maybe_sweep(self, now_ns: int, n_ops: int) -> None:
         """Policy state is shared with the asyncio engine — all policy
