@@ -18,12 +18,22 @@ cardinality.
 
 Flags: --cpu (force CPU backend for local runs), --quick (fewer batches),
 --json-extra (dump latency percentiles to stderr).
+
+Hardening: the accelerator on this host is reached through a tunnel whose
+relay can wedge (a process killed mid-claim leaves every later device query
+hanging forever with no error).  The first device touch therefore happens in
+a *subprocess* with a generous timeout; a hang is reported as a wedge
+diagnostic (distinct from a backend failure, which surfaces the backend's
+stderr) and the benchmark falls back to the CPU platform so a measured
+number is always produced.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -47,6 +57,52 @@ def zipf_indices(rng, n_keys, size, a=ZIPF_A):
     return rng.choice(n_keys, size=size, p=p)
 
 
+PROBE_TIMEOUT_S = 150  # healthy first claim+init takes seconds, not minutes
+
+
+def probe_accelerator(timeout_s: float = PROBE_TIMEOUT_S):
+    """First device touch, isolated in a subprocess with a timeout.
+
+    Returns (ok, detail).  A timeout means the tunnel relay is wedged (a
+    silent multi-minute hang, not a slow compile); a nonzero exit means the
+    backend failed to initialize and `detail` carries its stderr.  Either
+    way the parent process never touched the accelerator, so it can still
+    fall back to CPU cleanly.
+    """
+    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        r = subprocess.CompletedProcess(proc.args, proc.returncode, out, err)
+    except subprocess.TimeoutExpired:
+        # Ask nicely first: SIGTERM lets the interpreter run its cleanup
+        # and release any partial claim — SIGKILLing a claimant mid-claim
+        # is exactly what wedges the relay in the first place.
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        return False, (
+            f"WEDGE: device probe produced no response in {timeout_s}s — "
+            "the accelerator tunnel relay is wedged (a killed mid-claim "
+            "process poisons all later claims), not a benchmark failure"
+        )
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-6:]
+        return False, (
+            "BACKEND-INIT-FAILED: device probe exited rc="
+            f"{r.returncode}: " + (" | ".join(tail) or "no stderr")
+        )
+    return True, r.stdout.strip()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
@@ -54,7 +110,16 @@ def main() -> int:
     ap.add_argument("--json-extra", action="store_true")
     args = ap.parse_args()
 
-    if args.cpu:
+    fallback_reason = None
+    if not args.cpu:
+        ok, detail = probe_accelerator()
+        print(f"device probe: {detail}", file=sys.stderr)
+        if not ok:
+            fallback_reason = detail
+            print("falling back to CPU platform", file=sys.stderr)
+
+    if args.cpu or fallback_reason is not None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -139,6 +204,8 @@ def main() -> int:
         "n_keys": n_keys,
         "keymap": keymap_kind,
         "device": str(device),
+        "platform": device.platform,
+        "cpu_fallback_reason": fallback_reason,
     }
     print(json.dumps(extra), file=sys.stderr)
 

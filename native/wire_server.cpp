@@ -78,13 +78,36 @@ struct Slot {
     std::string payload;
 };
 
+// Driver-side identity of an in-flight THROTTLE, FIFO-paired with the
+// batch handed to ws_next_batch so ws_respond can route each result back
+// to its connection slot.
+struct Inflight {
+    uint64_t conn_gen;
+    int fd;
+    uint64_t slot_seq;
+    bool keep_alive;
+};
+
+// A completed response on its way from the driver thread to the IO
+// thread, addressed by (gen, fd, slot_seq).
+struct Response {
+    uint64_t conn_gen;
+    int fd;
+    uint64_t slot_seq;
+    bool close_after;
+    std::string payload;
+};
+
 struct Conn {
     int fd = -1;
     uint64_t gen = 0;
     std::string rbuf;
     std::string wbuf;
+    std::deque<Slot> slots;   // response order; front() has seq slot_base
+    uint64_t slot_base = 0;   // seq of slots.front()
     int64_t last_activity_ms = 0;
     bool closing = false;     // close once wbuf drains
+    bool draining = false;    // close-after slot enqueued: stop parsing
     bool want_write = false;
 };
 
@@ -93,8 +116,10 @@ struct Conn {
 // either).  Returns: 1 = one command parsed, 0 = need more data,
 // -1 = protocol error (err filled).
 int parse_command(const std::string& buf, size_t& consumed,
-                  std::vector<std::string>& out, std::string& err) {
+                  std::vector<std::string>& out,
+                  std::vector<uint8_t>& nulls, std::string& err) {
     out.clear();
+    nulls.clear();
     size_t pos = 0;
     auto read_line = [&](std::string& line) -> int {
         size_t idx = buf.find("\r\n", pos);
@@ -144,11 +169,15 @@ int parse_command(const std::string& buf, size_t& consumed,
             return -1;
         }
         if (len == -1) {
-            out.emplace_back();  // null bulk → empty (invalid for args)
+            // Null bulk string: kept distinct from "" so dispatch can
+            // reject it per-argument like the reference does.
+            out.emplace_back();
+            nulls.push_back(1);
             continue;
         }
         if (buf.size() < pos + static_cast<size_t>(len) + 2) return 0;
         out.emplace_back(buf, pos, len);
+        nulls.push_back(0);
         pos += len + 2;
     }
     consumed = pos;
@@ -303,12 +332,9 @@ struct WireServer {
     // Response routing: metas FIFO-paired with queue pops (see Inflight).
     std::deque<Inflight> inflight;  // guarded by q_mu
 
-    // driver → IO thread (serialized responses per conn).
+    // driver → IO thread (serialized responses per conn slot).
     std::mutex r_mu;
-    std::deque<std::pair<std::pair<uint64_t, int>, std::string>> responses;
-    // Conns to close once their queued response drains (HTTP
-    // Connection: close).
-    std::deque<std::pair<uint64_t, int>> close_marks;
+    std::deque<Response> responses;
 
     // /metrics snapshot pushed by the driver (HTTP protocol only).
     std::mutex m_mu;
@@ -453,6 +479,20 @@ struct WireServer {
         auto it = conns.find(fd);
         if (it == conns.end()) return;
         Conn& c = it->second;
+        if (c.draining || c.closing) {
+            // A close-after slot is queued (QUIT, protocol error): no more
+            // parsing, but keep consuming and discarding socket bytes —
+            // leaving them unread makes level-triggered epoll spin hot.
+            char junk[16384];
+            for (;;) {
+                ssize_t r = read(fd, junk, sizeof(junk));
+                if (r > 0) continue;
+                if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+                    return;
+                drop_conn(fd);  // EOF or error, matching the normal path
+                return;
+            }
+        }
         char tmp[16384];
         for (;;) {
             ssize_t r = read(fd, tmp, sizeof(tmp));
@@ -466,9 +506,9 @@ struct WireServer {
                 auto again = conns.find(fd);
                 if (again == conns.end() || &again->second != &c)
                     return;  // dropped (or rehashed after an erase)
-                if (c.closing) return;
+                if (c.closing || c.draining) return;
                 if (c.rbuf.size() > MAX_CONN_BUFFER) {
-                    send_raw(c, "-ERR request too large\r\n", true);
+                    emit_inline(c, "-ERR request too large\r\n", true);
                     return;
                 }
                 if (over_cap()) {
@@ -489,7 +529,7 @@ struct WireServer {
     }
 
     void process_buffer(Conn& first) {
-        // dispatch/send_raw may drop the connection (QUIT, write error),
+        // dispatch/emit_inline may drop the connection (write error),
         // destroying the Conn — re-resolve by fd + generation after every
         // step instead of holding a reference across them.
         const int fd = first.fd;
@@ -499,7 +539,7 @@ struct WireServer {
             auto it = conns.find(fd);
             if (it == conns.end() || it->second.gen != gen) break;
             Conn& c = it->second;
-            if (c.rbuf.empty() || c.closing) break;
+            if (c.rbuf.empty() || c.closing || c.draining) break;
             if (protocol == 1) {
                 int r = step_http(c);
                 if (r == 0) break;
@@ -508,23 +548,70 @@ struct WireServer {
             }
             size_t consumed = 0;
             std::vector<std::string> args;
+            std::vector<uint8_t> nulls;
             std::string err;
-            int r = parse_command(c.rbuf, consumed, args, err);
+            int r = parse_command(c.rbuf, consumed, args, nulls, err);
             if (r == 0) break;
             if (r < 0) {
-                send_raw(c, "-" + err + "\r\n", true);
+                emit_inline(c, "-" + err + "\r\n", true);
                 break;
             }
             c.rbuf.erase(0, consumed);
-            enqueued |= dispatch(c, args);
+            enqueued |= dispatch(c, args, nulls);
         }
         if (enqueued) q_cv.notify_one();
     }
 
+    // ---------------------------------------------------- response order #
+
+    // Move the contiguous ready prefix of the slot queue into the write
+    // buffer, then flush.  A flushed close-after slot (QUIT, protocol
+    // error, HTTP Connection: close) marks the connection closing and
+    // discards anything queued behind it.  May drop the connection —
+    // callers must re-resolve the Conn by fd afterwards.
+    void pump_slots(Conn& c) {
+        while (!c.slots.empty() && c.slots.front().ready) {
+            Slot& s = c.slots.front();
+            c.wbuf += s.payload;
+            const bool close_after = s.close_after;
+            c.slots.pop_front();
+            c.slot_base++;
+            if (close_after) {
+                c.closing = true;
+                c.slots.clear();
+                break;
+            }
+        }
+        flush(c);
+    }
+
+    // Append a ready (inline) response in arrival order.  Even though the
+    // payload is known immediately, it must still wait behind any
+    // unanswered THROTTLE slots ahead of it — pipelined responses leave
+    // in exactly request order.
+    void emit_inline(Conn& c, std::string payload, bool close_after) {
+        Slot s;
+        s.ready = true;
+        s.close_after = close_after;
+        s.payload = std::move(payload);
+        c.slots.push_back(std::move(s));
+        if (close_after) c.draining = true;
+        pump_slots(c);
+    }
+
+    // Reserve the next response slot for a driver-answered request and
+    // return its sequence number.
+    uint64_t reserve_slot(Conn& c) {
+        const uint64_t seq = c.slot_base + c.slots.size();
+        c.slots.emplace_back();
+        return seq;
+    }
+
     // ------------------------------------------------------------ HTTP #
 
-    void send_http(Conn& c, int status, const char* content_type,
-                   const std::string& body, bool keep_alive) {
+    static std::string http_payload(int status, const char* content_type,
+                                    const std::string& body,
+                                    bool keep_alive) {
         const char* reason =
             status == 200 ? "OK"
             : status == 400 ? "Bad Request"
@@ -536,7 +623,13 @@ struct WireServer {
                           "Content-Length: %zu\r\nConnection: %s\r\n\r\n",
                           status, reason, content_type, body.size(),
                           keep_alive ? "keep-alive" : "close");
-        send_raw(c, std::string(head, hn) + body, !keep_alive);
+        return std::string(head, hn) + body;
+    }
+
+    void send_http(Conn& c, int status, const char* content_type,
+                   const std::string& body, bool keep_alive) {
+        emit_inline(c, http_payload(status, content_type, body, keep_alive),
+                    !keep_alive);
     }
 
     // Returns 0 = need more data, 1 = handled inline, 2 = enqueued.
@@ -630,6 +723,7 @@ struct WireServer {
         }
         if (!json_int(body, "quantity", req.quantity))
             req.quantity = 1;  // http.rs:135
+        req.slot_seq = reserve_slot(c);
         {
             std::lock_guard<std::mutex> lk(q_mu);
             queue.push_back(std::move(req));
@@ -639,24 +733,37 @@ struct WireServer {
     }
 
     // Returns true if a THROTTLE landed in the pending queue.
-    bool dispatch(Conn& c, std::vector<std::string>& args) {
+    bool dispatch(Conn& c, std::vector<std::string>& args,
+                  const std::vector<uint8_t>& nulls) {
         n_inline++;
         if (args.empty()) {
-            send_raw(c, "-ERR empty command\r\n", false);
+            emit_inline(c, "-ERR empty command\r\n", false);
+            return false;
+        }
+        if (nulls[0]) {
+            // Null bulk command name, like a non-bulk frame element.
+            emit_inline(c, "-ERR invalid command format\r\n", false);
             return false;
         }
         const std::string cmd = upper(args[0]);
         if (cmd == "PING") {
             if (args.size() == 1) {
-                send_raw(c, "+PONG\r\n", false);
+                emit_inline(c, "+PONG\r\n", false);
             } else if (args.size() == 2) {
-                char head[32];
-                int hn = snprintf(head, sizeof(head), "$%zu\r\n",
-                                  args[1].size());
-                send_raw(c, std::string(head, hn) + args[1] + "\r\n",
-                         false);
+                if (nulls[1]) {
+                    // PING with a null message echoes null, matching the
+                    // asyncio backend's echo of BulkString(None).
+                    emit_inline(c, "$-1\r\n", false);
+                } else {
+                    char head[32];
+                    int hn = snprintf(head, sizeof(head), "$%zu\r\n",
+                                      args[1].size());
+                    emit_inline(c,
+                                std::string(head, hn) + args[1] + "\r\n",
+                                false);
+                }
             } else {
-                send_raw(
+                emit_inline(
                     c,
                     "-ERR wrong number of arguments for 'ping' command\r\n",
                     false);
@@ -664,55 +771,56 @@ struct WireServer {
             return false;
         }
         if (cmd == "QUIT") {
-            send_raw(c, "+OK\r\n", true);
+            emit_inline(c, "+OK\r\n", true);
             return false;
         }
         if (cmd != "THROTTLE") {
-            send_raw(c, "-ERR unknown command '" + cmd + "'\r\n", false);
+            emit_inline(c, "-ERR unknown command '" + cmd + "'\r\n", false);
             return false;
         }
         if (args.size() < 5 || args.size() > 6) {
-            send_raw(
+            emit_inline(
                 c,
                 "-ERR wrong number of arguments for 'throttle' "
                 "command\r\n",
                 false);
             return false;
         }
+        if (nulls[1]) {
+            emit_inline(c, "-ERR invalid key\r\n", false);
+            return false;
+        }
         PendingRequest req;
         req.conn_gen = c.gen;
         req.fd = c.fd;
         req.key = args[1];
-        if (!parse_i64_ascii(args[2], req.max_burst)) {
-            send_raw(c, "-ERR invalid max_burst\r\n", false);
+        // Null numeric args arrive as "" and fail the i64 parse, yielding
+        // the same per-argument errors the asyncio backend produces.
+        if (nulls[2] || !parse_i64_ascii(args[2], req.max_burst)) {
+            emit_inline(c, "-ERR invalid max_burst\r\n", false);
             return false;
         }
-        if (!parse_i64_ascii(args[3], req.count_per_period)) {
-            send_raw(c, "-ERR invalid count_per_period\r\n", false);
+        if (nulls[3] || !parse_i64_ascii(args[3], req.count_per_period)) {
+            emit_inline(c, "-ERR invalid count_per_period\r\n", false);
             return false;
         }
-        if (!parse_i64_ascii(args[4], req.period)) {
-            send_raw(c, "-ERR invalid period\r\n", false);
+        if (nulls[4] || !parse_i64_ascii(args[4], req.period)) {
+            emit_inline(c, "-ERR invalid period\r\n", false);
             return false;
         }
         req.quantity = 1;
         if (args.size() == 6 &&
-            !parse_i64_ascii(args[5], req.quantity)) {
-            send_raw(c, "-ERR invalid quantity\r\n", false);
+            (nulls[5] || !parse_i64_ascii(args[5], req.quantity))) {
+            emit_inline(c, "-ERR invalid quantity\r\n", false);
             return false;
         }
+        req.slot_seq = reserve_slot(c);
         {
             std::lock_guard<std::mutex> lk(q_mu);
             queue.push_back(std::move(req));
         }
         n_requests++;
         return true;
-    }
-
-    void send_raw(Conn& c, const std::string& data, bool then_close) {
-        c.wbuf += data;
-        if (then_close) c.closing = true;
-        flush(c);
     }
 
     void flush(Conn& c) {
@@ -752,30 +860,33 @@ struct WireServer {
                 set_reading(true);
             }
         }
-        std::deque<std::pair<std::pair<uint64_t, int>, std::string>> local;
-        std::deque<std::pair<uint64_t, int>> closes;
+        std::deque<Response> local;
         {
             std::lock_guard<std::mutex> lk(r_mu);
             local.swap(responses);
-            closes.swap(close_marks);
         }
-        for (auto& [who, payload] : local) {
-            auto it = conns.find(who.second);
-            if (it == conns.end() || it->second.gen != who.first)
+        // Fill every addressed slot first, then pump each connection once
+        // — pipelined responses coalesce into fewer writes, and the ready
+        // prefix leaves in exactly request order.
+        std::vector<int> touched;
+        for (auto& r : local) {
+            auto it = conns.find(r.fd);
+            if (it == conns.end() || it->second.gen != r.conn_gen)
                 continue;  // connection died while the batch was in flight
-            it->second.wbuf += payload;
+            Conn& c = it->second;
+            if (r.slot_seq < c.slot_base) continue;  // discarded by close
+            const size_t idx = r.slot_seq - c.slot_base;
+            if (idx >= c.slots.size()) continue;
+            Slot& s = c.slots[idx];
+            s.payload = std::move(r.payload);
+            s.close_after = r.close_after;
+            s.ready = true;
+            if (touched.empty() || touched.back() != r.fd)
+                touched.push_back(r.fd);
         }
-        for (auto& who : closes) {
-            auto it = conns.find(who.second);
-            if (it != conns.end() && it->second.gen == who.first)
-                it->second.closing = true;
-        }
-        // Flush after all appends so pipelined responses coalesce into
-        // fewer writes per connection.
-        for (auto& [who, payload] : local) {
-            auto it = conns.find(who.second);
-            if (it != conns.end() && it->second.gen == who.first)
-                flush(it->second);
+        for (int fd : touched) {
+            auto it = conns.find(fd);
+            if (it != conns.end()) pump_slots(it->second);
         }
     }
 };
@@ -846,7 +957,8 @@ int64_t ws_next_batch(void* h, int64_t timeout_us, int64_t max_n,
         params[4 * n + 3] = req.quantity;
         cookie_gen[n] = req.conn_gen;
         cookie_fd[n] = req.fd;
-        s->inflight.push_back({req.conn_gen, req.fd, req.keep_alive});
+        s->inflight.push_back(
+            {req.conn_gen, req.fd, req.slot_seq, req.keep_alive});
         s->queue.pop_front();
         n++;
     }
@@ -871,10 +983,13 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
     {
         std::lock_guard<std::mutex> lk(s->r_mu);
         for (int64_t i = 0; i < n; i++) {
-            const Inflight meta = i < static_cast<int64_t>(metas.size())
-                                      ? metas[i]
-                                      : Inflight{cookie_gen[i],
-                                                 cookie_fd[i], true};
+            if (i >= static_cast<int64_t>(metas.size())) break;
+            // The meta carries the response slot; without it (a driver
+            // double-respond bug) the result cannot be ordered, so it is
+            // dropped rather than mis-delivered.
+            const Inflight& meta = metas[i];
+            if (meta.conn_gen != cookie_gen[i] || meta.fd != cookie_fd[i])
+                continue;  // driver responded out of order; unroutable
             std::string payload;
             if (s->protocol == 1) {
                 std::string body;
@@ -931,11 +1046,10 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
             } else {
                 payload = "-ERR internal error\r\n";
             }
-            s->responses.emplace_back(
-                std::make_pair(meta.conn_gen, meta.fd),
-                std::move(payload));
-            if (s->protocol == 1 && !meta.keep_alive)
-                s->close_marks.emplace_back(meta.conn_gen, meta.fd);
+            s->responses.push_back(
+                {meta.conn_gen, meta.fd, meta.slot_seq,
+                 s->protocol == 1 && !meta.keep_alive,
+                 std::move(payload)});
         }
     }
     uint64_t one = 1;

@@ -6,12 +6,23 @@ import json
 
 import pytest
 
-from throttlecrab_tpu.native import wire_available
+from throttlecrab_tpu.native import (
+    toolchain_available,
+    wire_available,
+    wire_build_error,
+)
 from throttlecrab_tpu.server.metrics import Metrics
 from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
 
+if not wire_available() and toolchain_available():
+    pytest.fail(
+        "C++ wire server failed to build with g++ present:\n"
+        f"{wire_build_error()}",
+        pytrace=False,
+    )
 pytestmark = pytest.mark.skipif(
-    not wire_available(), reason="no C++ toolchain for the wire server"
+    not wire_available(),
+    reason=f"no C++ toolchain for the wire server: {wire_build_error()}",
 )
 
 T0 = 1_700_000_000 * 1_000_000_000

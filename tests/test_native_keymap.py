@@ -3,11 +3,23 @@
 import numpy as np
 import pytest
 
-from throttlecrab_tpu.native import NativeKeyMap, native_available
+from throttlecrab_tpu.native import (
+    NativeKeyMap,
+    keymap_build_error,
+    native_available,
+    toolchain_available,
+)
 from throttlecrab_tpu.tpu.limiter import segment_info
 
+if not native_available() and toolchain_available():
+    pytest.fail(
+        "C++ keymap failed to build with g++ present:\n"
+        f"{keymap_build_error()}",
+        pytrace=False,
+    )
 pytestmark = pytest.mark.skipif(
-    not native_available(), reason="native keymap toolchain unavailable"
+    not native_available(),
+    reason=f"native keymap toolchain unavailable: {keymap_build_error()}",
 )
 
 

@@ -5,12 +5,25 @@ import asyncio
 
 import pytest
 
-from throttlecrab_tpu.native import wire_available
+from throttlecrab_tpu.native import (
+    toolchain_available,
+    wire_available,
+    wire_build_error,
+)
 from throttlecrab_tpu.server.metrics import Metrics
 from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
 
+# A broken build with a compiler present is a bug, not an environment gap:
+# fail the whole module loudly instead of skipping.
+if not wire_available() and toolchain_available():
+    pytest.fail(
+        "C++ wire server failed to build with g++ present:\n"
+        f"{wire_build_error()}",
+        pytrace=False,
+    )
 pytestmark = pytest.mark.skipif(
-    not wire_available(), reason="no C++ toolchain for the wire server"
+    not wire_available(),
+    reason=f"no C++ toolchain for the wire server: {wire_build_error()}",
 )
 
 T0 = 1_700_000_000 * 1_000_000_000
@@ -176,6 +189,109 @@ def test_native_protocol_attack_vectors():
 
     for out in asyncio.run(main()):
         assert out.startswith(b"-ERR")
+
+
+def _frame(*parts):
+    """RESP array frame; None parts encode as null bulk strings ($-1)."""
+    frame = b"*%d\r\n" % len(parts)
+    for part in parts:
+        if part is None:
+            frame += b"$-1\r\n"
+        else:
+            data = part.encode() if isinstance(part, str) else part
+            frame += b"$%d\r\n%s\r\n" % (len(data), data)
+    return frame
+
+
+def test_native_pipelined_inline_after_throttle_stays_ordered():
+    """A PING pipelined behind a THROTTLE must answer after it: inline
+    replies wait for the driver-answered slots ahead of them."""
+
+    async def main():
+        transport, _ = make_transport()
+        await transport.start()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", transport.bound_port
+        )
+        writer.write(
+            _frame("THROTTLE", "ok1", "10", "100", "60") + _frame("PING")
+        )
+        await writer.drain()
+        data = b""
+        while b"+PONG\r\n" not in data:
+            chunk = await asyncio.wait_for(reader.read(4096), timeout=5.0)
+            assert chunk, f"connection closed early: {data!r}"
+            data += chunk
+        writer.close()
+        await transport.stop()
+        return data
+
+    data = asyncio.run(main())
+    assert data.index(b"*5\r\n:1\r\n") < data.index(b"+PONG\r\n")
+
+
+def test_native_quit_waits_for_pipelined_throttle():
+    """QUIT pipelined behind THROTTLEs must deliver their responses, then
+    +OK, then close — not close early and drop them."""
+
+    async def main():
+        transport, _ = make_transport()
+        await transport.start()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", transport.bound_port
+        )
+        writer.write(
+            _frame("THROTTLE", "qk1", "10", "100", "60")
+            + _frame("THROTTLE", "qk2", "10", "100", "60")
+            + _frame("QUIT")
+        )
+        await writer.drain()
+        data = b""
+        while True:
+            chunk = await asyncio.wait_for(reader.read(4096), timeout=5.0)
+            if not chunk:
+                break
+            data += chunk
+        await transport.stop()
+        return data
+
+    data = asyncio.run(main())
+    assert data.count(b"*5\r\n:1\r\n") == 2
+    assert data.endswith(b"+OK\r\n")
+
+
+def test_native_null_bulk_arguments_rejected():
+    async def main():
+        transport, _ = make_transport()
+        await transport.start()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", transport.bound_port
+        )
+
+        async def roundtrip(frame):
+            writer.write(frame)
+            await writer.drain()
+            return await asyncio.wait_for(reader.read(4096), timeout=5.0)
+
+        outs = {
+            "null_key": await roundtrip(
+                _frame("THROTTLE", None, "10", "100", "60")
+            ),
+            "null_cmd": await roundtrip(_frame(None, "x")),
+            "null_burst": await roundtrip(
+                _frame("THROTTLE", "k", None, "100", "60")
+            ),
+            "null_ping": await roundtrip(_frame("PING", None)),
+        }
+        writer.close()
+        await transport.stop()
+        return outs
+
+    outs = asyncio.run(main())
+    assert outs["null_key"] == b"-ERR invalid key\r\n"
+    assert outs["null_cmd"] == b"-ERR invalid command format\r\n"
+    assert outs["null_burst"] == b"-ERR invalid max_burst\r\n"
+    assert outs["null_ping"] == b"$-1\r\n"  # echoes null like asyncio
 
 
 def test_native_concurrent_clients_share_limits():

@@ -9,6 +9,7 @@ the batch arrays travel as numpy pointers.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -27,17 +28,34 @@ _build_error: Optional[str] = None
 
 
 def _compile(src: Path, out: Path, extra=()) -> Optional[str]:
-    """Build a shared library if stale; returns an error string or None."""
+    """Build a shared library if stale; returns an error string or None.
+
+    Staleness is keyed on a content hash of the source (recorded next to
+    the output), not mtimes: a fresh git clone assigns equal mtimes, which
+    once let a stale committed binary silently shadow broken source.
+    """
     out.parent.mkdir(parents=True, exist_ok=True)
-    if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()
+    stamp = out.with_suffix(out.suffix + ".sha256")
+    if (
+        not out.exists()
+        or not stamp.exists()
+        or stamp.read_text().strip() != digest
+    ):
         cmd = [
             "g++", "-O3", "-march=native", "-std=c++17", "-shared",
             "-fPIC", str(src), "-o", str(out), *extra,
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        except (subprocess.SubprocessError, FileNotFoundError) as e:
-            return str(e)
+        except FileNotFoundError as e:
+            return f"g++ not found: {e}"
+        except subprocess.CalledProcessError as e:
+            stderr = (e.stderr or b"").decode(errors="replace")
+            return f"{src.name} failed to compile:\n{stderr[-2000:]}"
+        except subprocess.SubprocessError as e:
+            return f"{src.name} build error: {e}"
+        stamp.write_text(digest)
     return None
 
 
@@ -81,6 +99,20 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return get_lib() is not None
+
+
+def toolchain_available() -> bool:
+    """True when a C++ compiler exists — build failures are then bugs,
+    not environment gaps, and tests must fail rather than skip."""
+    import shutil
+
+    return shutil.which("g++") is not None
+
+
+def keymap_build_error() -> Optional[str]:
+    """The keymap build failure (with compiler stderr), or None."""
+    get_lib()
+    return _build_error
 
 
 # ------------------------------------------------------------------ #
@@ -136,6 +168,12 @@ def get_wire_lib() -> Optional[ctypes.CDLL]:
 
 def wire_available() -> bool:
     return get_wire_lib() is not None
+
+
+def wire_build_error() -> Optional[str]:
+    """The wire-server build failure (with compiler stderr), or None."""
+    get_wire_lib()
+    return _ws_error
 
 
 class NativeKeyMap:
