@@ -363,3 +363,36 @@ def test_multi_transport_shared_limits():
         return seq
 
     assert asyncio.run(main()) == [True, True, True, True, False]
+
+
+def test_stop_with_open_connections_returns_promptly():
+    """stop() must drop idle open connections (the reference aborts its
+    transport tasks on shutdown) instead of waiting out the 5-minute idle
+    read — Server.wait_closed() on 3.12+ waits for every handler."""
+
+    async def main():
+        engine, metrics = make_stack()
+        http_t = HttpTransport("127.0.0.1", 0, engine, metrics)
+        redis_t = RedisTransport("127.0.0.1", 0, engine, metrics)
+        await http_t.start()
+        await redis_t.start()
+
+        # One live connection per transport, both left open and idle.
+        r1, w1 = await asyncio.open_connection(
+            "127.0.0.1", redis_t.bound_port
+        )
+        out = await resp_command(r1, w1, "THROTTLE", "sd", "3", "10", "60")
+        assert out.startswith(b"*5\r\n:1\r\n")
+        r2, w2 = await asyncio.open_connection(
+            "127.0.0.1", http_t.bound_port
+        )
+        w2.write(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+        await w2.drain()
+        await r2.read(64)  # keep-alive: handler stays in its read loop
+
+        await asyncio.wait_for(redis_t.stop(), timeout=5.0)
+        await asyncio.wait_for(http_t.stop(), timeout=5.0)
+        for w in (w1, w2):
+            w.close()
+
+    asyncio.run(main())

@@ -1,0 +1,207 @@
+"""The wire fast path (compact i32 whole-second outputs + certified
+with_degen compile-out) must be observationally identical to the exact ns
+path modulo the documented wire truncation: seconds = ns // 1e9, remaining
+saturated at i32::MAX — the reference's own type-boundary truncation
+(types.rs:87-97) and proto narrowing (throttlecrab.proto:15-21).
+"""
+
+import numpy as np
+import pytest
+
+from throttlecrab_tpu.parallel.sharded import (
+    ShardedTpuRateLimiter,
+    make_mesh,
+)
+from throttlecrab_tpu.tpu.limiter import TpuRateLimiter, WireBatchResult
+
+NS = 1_000_000_000
+T0 = 1_700_000_000 * NS
+I32_MAX = (1 << 31) - 1
+
+
+def random_batches(rng, n_batches, with_degen):
+    """Heterogeneous-parameter batches; degen sprinkles quantity-0 probes
+    and burst-1 keys into the traffic."""
+    batches = []
+    for k in range(n_batches):
+        n = int(rng.integers(3, 80))
+        keys = [f"k{int(x)}" for x in rng.integers(0, 30, n)]
+        burst = rng.integers(1 if with_degen else 2, 20, n).tolist()
+        count = rng.integers(1, 1000, n).tolist()
+        period = rng.integers(1, 3600, n).tolist()
+        quantity = rng.integers(0 if with_degen else 1, 4, n).tolist()
+        batches.append(
+            (keys, burst, count, period, quantity, T0 + k * 77_000_000)
+        )
+    return batches
+
+
+def assert_wire_matches(exact, wire):
+    assert isinstance(wire, WireBatchResult)
+    assert wire.allowed.tolist() == exact.allowed.tolist()
+    assert wire.limit.tolist() == exact.limit.tolist()
+    assert wire.status.tolist() == exact.status.tolist()
+    want_rem = np.minimum(exact.remaining, I32_MAX)
+    assert wire.remaining.tolist() == want_rem.tolist()
+    assert wire.reset_after_s.tolist() == (
+        np.minimum(exact.reset_after_ns // NS, I32_MAX).tolist()
+    )
+    assert wire.retry_after_s.tolist() == (
+        np.minimum(exact.retry_after_ns // NS, I32_MAX).tolist()
+    )
+
+
+@pytest.mark.parametrize("degen", [False, True])
+def test_wire_batch_matches_exact_path(degen):
+    """Same traffic through two fresh limiters: wire vs exact must agree
+    per request.  Covers both certification outcomes: degen-free traffic
+    (with_degen compiled out) and traffic with quantity-0/burst-1."""
+    rng = np.random.default_rng(11 if degen else 7)
+    batches = random_batches(rng, 6, degen)
+
+    exact = TpuRateLimiter(capacity=256)
+    wired = TpuRateLimiter(capacity=256)
+    for b in batches:
+        e = exact.rate_limit_batch(*b)
+        w = wired.rate_limit_batch(*b, wire=True)
+        assert_wire_matches(e, w)
+
+
+@pytest.mark.parametrize("degen", [False, True])
+def test_wire_many_matches_exact_path(degen):
+    rng = np.random.default_rng(23 if degen else 19)
+    batches = random_batches(rng, 5, degen)
+
+    exact = TpuRateLimiter(capacity=256)
+    wired = TpuRateLimiter(capacity=256)
+    want = [exact.rate_limit_batch(*b) for b in batches]
+    got = wired.rate_limit_many(batches, wire=True)
+    for e, w in zip(want, got):
+        assert_wire_matches(e, w)
+
+
+def test_wire_param_conflict_fallback_stays_wire():
+    """The sequential fallback (param change mid-batch) must still return
+    wire-unit results."""
+    batches = [
+        (["p", "p"], [5, 2], [10, 10], [60, 60], 1, T0),
+        (["p"], 2, 10, 60, 1, T0 + 1),
+    ]
+    exact = TpuRateLimiter(capacity=64)
+    want = [exact.rate_limit_batch(*b) for b in batches]
+    wired = TpuRateLimiter(capacity=64)
+    got = wired.rate_limit_many(batches, wire=True)
+    for e, w in zip(want, got):
+        assert_wire_matches(e, w)
+
+
+# ---------------------------------------------------------------- sharded #
+
+
+def test_sharded_wire_batch_matches_exact():
+    rng = np.random.default_rng(31)
+    batches = random_batches(rng, 4, True)
+    mesh_a = make_mesh(4)
+    mesh_b = make_mesh(4)
+    exact = ShardedTpuRateLimiter(capacity_per_shard=128, mesh=mesh_a)
+    wired = ShardedTpuRateLimiter(capacity_per_shard=128, mesh=mesh_b)
+    for b in batches:
+        e = exact.rate_limit_batch(*b)
+        w = wired.rate_limit_batch(*b, wire=True)
+        assert_wire_matches(e, w)
+    assert wired.total_allowed == exact.total_allowed
+    assert wired.total_denied == exact.total_denied
+
+
+@pytest.mark.parametrize("wire", [False, True])
+def test_sharded_many_matches_sequential(wire):
+    """ShardedTpuRateLimiter.rate_limit_many (one mesh launch for K
+    sub-batches) == K sequential rate_limit_batch calls, including the
+    psum-reduced counters."""
+    rng = np.random.default_rng(43)
+    batches = random_batches(rng, 6, False)
+
+    seq = ShardedTpuRateLimiter(capacity_per_shard=128, mesh=make_mesh(4))
+    want = [seq.rate_limit_batch(*b, wire=wire) for b in batches]
+    scan = ShardedTpuRateLimiter(capacity_per_shard=128, mesh=make_mesh(4))
+    got = scan.rate_limit_many(batches, wire=wire)
+
+    for k, (w, g) in enumerate(zip(want, got)):
+        assert w.allowed.tolist() == g.allowed.tolist(), f"sub-batch {k}"
+        assert w.remaining.tolist() == g.remaining.tolist(), f"sub-batch {k}"
+        assert w.status.tolist() == g.status.tolist(), f"sub-batch {k}"
+        if wire:
+            assert w.reset_after_s.tolist() == g.reset_after_s.tolist()
+            assert w.retry_after_s.tolist() == g.retry_after_s.tolist()
+        else:
+            assert w.reset_after_ns.tolist() == g.reset_after_ns.tolist()
+            assert w.retry_after_ns.tolist() == g.retry_after_ns.tolist()
+    assert scan.total_allowed == seq.total_allowed
+    assert scan.total_denied == seq.total_denied
+
+
+def test_sharded_many_cross_batch_state_carries():
+    """Burst 10, 4 sub-batches x 4 hits on one key through the mesh scan:
+    exactly 10 allowed in arrival order across the window."""
+    batches = [(["hot"] * 4, 10, 100, 3600, 1, T0 + k) for k in range(4)]
+    lim = ShardedTpuRateLimiter(capacity_per_shard=64, mesh=make_mesh(4))
+    results = lim.rate_limit_many(batches)
+    allowed = [bool(a) for r in results for a in r.allowed]
+    assert allowed == [True] * 10 + [False] * 6
+    assert lim.total_allowed == 10 and lim.total_denied == 6
+
+
+def test_engine_backlog_drains_through_sharded_scan(monkeypatch):
+    """The serving engine's backlog path must take ONE rate_limit_many
+    launch on the mesh when shards > 1 — the case that used to silently
+    degrade to one-batch-per-launch."""
+    import asyncio
+
+    from throttlecrab_tpu.server.engine import BatchingEngine
+    from throttlecrab_tpu.server.types import ThrottleRequest
+
+    limiter = ShardedTpuRateLimiter(
+        capacity_per_shard=1024, mesh=make_mesh(4)
+    )
+    many_calls = []
+    orig = limiter.rate_limit_many
+
+    def spy(batches, **kw):
+        many_calls.append(len(batches))
+        return orig(batches, **kw)
+
+    monkeypatch.setattr(limiter, "rate_limit_many", spy)
+
+    async def main():
+        engine = BatchingEngine(
+            limiter, batch_size=32, max_linger_us=100_000,
+            now_fn=lambda: T0,
+        )
+        return await asyncio.gather(
+            *[
+                engine.throttle(
+                    ThrottleRequest(f"w{i % 40}", 50, 100, 3600, 1)
+                )
+                for i in range(300)
+            ]
+        )
+
+    results = asyncio.run(main())
+    assert all(r.allowed for r in results)
+    assert many_calls and max(many_calls) > 1  # scan path engaged
+
+
+def test_sharded_many_param_conflict_falls_back():
+    batches = [
+        (["p", "p"], [5, 2], [10, 10], [60, 60], 1, T0),
+        (["p"], 2, 10, 60, 1, T0 + 1),
+    ]
+    seq = ShardedTpuRateLimiter(capacity_per_shard=64, mesh=make_mesh(2))
+    want = [seq.rate_limit_batch(*b) for b in batches]
+    scan = ShardedTpuRateLimiter(capacity_per_shard=64, mesh=make_mesh(2))
+    got = scan.rate_limit_many(batches)
+    for w, g in zip(want, got):
+        assert w.allowed.tolist() == g.allowed.tolist()
+        assert w.remaining.tolist() == g.remaining.tolist()
+        assert w.reset_after_ns.tolist() == g.reset_after_ns.tolist()
+        assert w.retry_after_ns.tolist() == g.retry_after_ns.tolist()

@@ -41,6 +41,9 @@ from ..tpu.keymap import PyKeyMap
 from ..tpu.limiter import (
     BatchResult,
     ScalarCompatMixin,
+    TpuRateLimiter,
+    WireBatchResult,
+    has_degenerate,
     param_rounds,
     prepare_batch,
     segment_info,
@@ -174,6 +177,97 @@ class ShardedBucketTable:
 
     # ------------------------------------------------------------------ #
 
+    def _scan_step(self, with_degen: bool, compact: bool):
+        """Build (and cache) the jitted shard-mapped K-deep scan step.
+
+        The backlog-draining analog of kernel.gcra_scan on the mesh: each
+        device scans its own K sub-batches against its local shard (the
+        lax.scan carry is the shard's state), so one launch decides K×D
+        sub-batches; the only collective is one psum of the summed
+        counters after the scan.
+        """
+        key = ("scan", with_degen, compact)
+        fn = self._step_cache.get(key)
+        if fn is not None:
+            return fn
+
+        def local(state, slots, rank, is_last, em, tol, q, valid, now):
+            def step(st, batch):
+                sl, rk, il, e, t, qq, v, nw = batch
+                st, out = _gcra_body(
+                    st,
+                    (sl, rk.astype(jnp.int64), il, e, t, qq, v, nw),
+                    with_degen=with_degen,
+                    compact=compact,
+                )
+                n_allowed = jnp.sum((out[0] != 0).astype(jnp.int64))
+                n_valid = jnp.sum(v.astype(jnp.int64))
+                return st, (out, jnp.stack([n_allowed, n_valid - n_allowed]))
+
+            st, (outs, counts) = lax.scan(
+                step,
+                state[0],
+                (
+                    slots[0], rank[0], is_last[0], em[0], tol[0], q[0],
+                    valid[0], now,
+                ),
+            )
+            counters = lax.psum(counts.sum(axis=0), AXIS)
+            return st[None], outs[None], counters
+
+        mapped = _shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(
+                P(AXIS, None, None),
+                *([P(AXIS, None, None)] * 7),
+                P(),
+            ),
+            out_specs=(
+                P(AXIS, None, None),
+                P(AXIS, None, None, None),
+                P(),
+            ),
+        )
+        fn = jax.jit(mapped, donate_argnums=(0,))
+        self._step_cache[key] = fn
+        return fn
+
+    def check_many(
+        self,
+        slots,
+        rank,
+        is_last,
+        emission,
+        tolerance,
+        quantity,
+        valid,
+        now_ns,
+        with_degen: bool = True,
+        compact: bool = False,
+    ):
+        """K stacked sub-batches per shard (``[D, K, B]`` inputs, i64[K]
+        timestamps) in ONE launch.
+
+        Returns (out[D, K, 4, B] device array, (allowed, denied) totals).
+        """
+        assert slots.shape[2] <= self.SCRATCH
+        step = self._scan_step(with_degen, compact)
+        self.state, out, counters = step(
+            self.state,
+            jnp.asarray(slots, jnp.int32),
+            jnp.asarray(rank, jnp.int32),
+            jnp.asarray(is_last, bool),
+            jnp.asarray(emission, jnp.int64),
+            jnp.asarray(tolerance, jnp.int64),
+            jnp.asarray(quantity, jnp.int64),
+            jnp.asarray(valid, bool),
+            jnp.asarray(now_ns, jnp.int64),
+        )
+        return out, counters
+
+    # ------------------------------------------------------------------ #
+
     def _sweep_fn(self):
         """Build (and cache) the jitted shard-mapped sweep."""
         fn = self._step_cache.get("sweep")
@@ -288,15 +382,13 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
 
     # ------------------------------------------------------------------ #
 
-    def rate_limit_batch(
-        self,
-        keys: Sequence,
-        max_burst,
-        count_per_period,
-        period,
-        quantity,
-        now_ns: int,
-    ) -> BatchResult:
+    def _prepare_sharded(
+        self, keys, max_burst, count_per_period, period, quantity, now_ns
+    ):
+        """Shared per-batch prologue: validate, derive params, route keys
+        to shards, resolve per-shard slots (growing on full), build the
+        stacked [D, B] arrays + conflict rounds.  One implementation for
+        the single-batch and scan paths."""
         if now_ns < 0:
             raise ValueError("batch now_ns must be non-negative")
         n = len(keys)
@@ -363,6 +455,50 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
                     rounds[d], sl, range(m),
                     emission[ix], tolerance[ix], quantity[ix],
                 )
+        return (n, per_shard, slots, rank, is_last, em, tol, q, vmask,
+                rounds, max_burst, status, valid, emission, tolerance,
+                quantity)
+
+    @staticmethod
+    def _make_result(valid, max_burst, status, allowed, remaining,
+                     reset_after, retry_after, wire):
+        fields = dict(
+            allowed=allowed,
+            limit=np.where(valid, max_burst, 0),
+            remaining=remaining,
+            status=status,
+        )
+        if wire:
+            return WireBatchResult(
+                reset_after_s=reset_after, retry_after_s=retry_after,
+                **fields,
+            )
+        return BatchResult(
+            reset_after_ns=reset_after, retry_after_ns=retry_after,
+            **fields,
+        )
+
+    def rate_limit_batch(
+        self,
+        keys: Sequence,
+        max_burst,
+        count_per_period,
+        period,
+        quantity,
+        now_ns: int,
+        wire: bool = False,
+    ) -> BatchResult:
+        (n, per_shard, slots, rank, is_last, em, tol, q, vmask, rounds,
+         max_burst, status, valid, emission, tolerance, quantity) = (
+            self._prepare_sharded(
+                keys, max_burst, count_per_period, period, quantity, now_ns
+            )
+        )
+        D = self.n_shards
+        B = slots.shape[1]
+        with_degen = not wire or has_degenerate(
+            valid, emission, tolerance, quantity
+        )
 
         allowed = np.zeros(n, bool)
         remaining = np.zeros(n, np.int64)
@@ -382,7 +518,8 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
                 for d in range(D):
                     rk[d], il[d] = segment_info(slots[d], rmask[d])
             out_dev, counters = self.table.check_batch(
-                slots, rk, il, em, tol, q, rmask, now_ns
+                slots, rk, il, em, tol, q, rmask, now_ns,
+                with_degen=with_degen, compact=wire,
             )
             out = np.asarray(out_dev)
             c = np.asarray(counters)
@@ -399,14 +536,126 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
                 reset_after[dst] = out[d, 2, :m][sel]
                 retry_after[dst] = out[d, 3, :m][sel]
 
-        return BatchResult(
-            allowed=allowed,
-            limit=np.where(valid, max_burst, 0),
-            remaining=remaining,
-            reset_after_ns=reset_after,
-            retry_after_ns=retry_after,
-            status=status,
+        return self._make_result(
+            valid, max_burst, status, allowed, remaining,
+            reset_after, retry_after, wire,
         )
+
+    # ------------------------------------------------------------------ #
+
+    def rate_limit_many(self, batches, wire: bool = False) -> list:
+        """Decide K whole batches in ONE mesh launch (scanned shard_map).
+
+        Same contract as TpuRateLimiter.rate_limit_many: `batches` is a
+        list of (keys, max_burst, count_per_period, period, quantity,
+        now_ns) tuples in arrival order; each sub-batch sees the sharded
+        table state left by the previous one.  Batches whose keys change
+        parameters mid-batch fall back to the sequential per-batch path
+        (rare; exactness beats speed).
+        """
+        if not batches:
+            return []
+        if len(batches) == 1:
+            return [self.rate_limit_batch(*batches[0], wire=wire)]
+
+        prepared = []
+        width = self.MIN_PAD
+        any_degen = False
+        fallback = False
+        for b in batches:
+            prep = self._prepare_sharded(*b)
+            (n, per_shard, slots, rank, is_last, em, tol, q, vmask,
+             rounds, max_burst, status, valid, emission, tolerance,
+             quantity) = prep
+            if rounds.any():
+                fallback = True
+                break
+            any_degen = any_degen or has_degenerate(
+                valid, emission, tolerance, quantity
+            )
+            prepared.append(prep)
+            width = max(width, slots.shape[1])
+        if fallback:
+            # Errors are isolated per batch — earlier batches' decisions
+            # are already committed on-device and must still be delivered.
+            # Re-deciding already-prepared batches is safe: prep only
+            # resolves slots (idempotent), no device writes happened yet.
+            out = []
+            failed = False
+            for b in batches:
+                if failed:
+                    out.append(
+                        TpuRateLimiter._error_result(len(b[0]), wire=wire)
+                    )
+                    continue
+                try:
+                    out.append(self.rate_limit_batch(*b, wire=wire))
+                except Exception:
+                    failed = True
+                    out.append(
+                        TpuRateLimiter._error_result(len(b[0]), wire=wire)
+                    )
+            return out
+
+        D = self.n_shards
+        K = len(prepared)
+        K_pad = 1 << (K - 1).bit_length()
+        shape = (D, K_pad, width)
+        slots_s = np.zeros(shape, np.int32)
+        rank_s = np.zeros(shape, np.int32)
+        last_s = np.ones(shape, bool)
+        em_s = np.zeros(shape, np.int64)
+        tol_s = np.zeros(shape, np.int64)
+        q_s = np.zeros(shape, np.int64)
+        valid_s = np.zeros(shape, bool)
+        now_s = np.full(K_pad, batches[-1][5], np.int64)
+        for j, prep in enumerate(prepared):
+            (n, per_shard, slots, rank, is_last, em, tol, q, vmask,
+             rounds, max_burst, status, valid, emission, tolerance,
+             quantity) = prep
+            Bj = slots.shape[1]
+            slots_s[:, j, :Bj] = slots
+            rank_s[:, j, :Bj] = rank
+            last_s[:, j, :Bj] = is_last
+            em_s[:, j, :Bj] = em
+            tol_s[:, j, :Bj] = tol
+            q_s[:, j, :Bj] = q
+            valid_s[:, j, :Bj] = vmask
+            now_s[j] = batches[j][5]
+
+        out_dev, counters = self.table.check_many(
+            slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s,
+            with_degen=not wire or any_degen, compact=wire,
+        )
+        out = np.asarray(out_dev)
+        c = np.asarray(counters)
+        self.total_allowed += int(c[0])
+        self.total_denied += int(c[1])
+
+        results = []
+        for j, prep in enumerate(prepared):
+            (n, per_shard, slots, rank, is_last, em, tol, q, vmask,
+             rounds, max_burst, status, valid, emission, tolerance,
+             quantity) = prep
+            allowed = np.zeros(n, bool)
+            remaining = np.zeros(n, np.int64)
+            reset_after = np.zeros(n, np.int64)
+            retry_after = np.zeros(n, np.int64)
+            for d, ix in enumerate(per_shard):
+                m = len(ix)
+                if m == 0:
+                    continue
+                allowed[ix] = out[d, j, 0, :m] != 0
+                remaining[ix] = out[d, j, 1, :m]
+                reset_after[ix] = out[d, j, 2, :m]
+                retry_after[ix] = out[d, j, 3, :m]
+            results.append(
+                self._make_result(
+                    valid, max_burst, status, allowed, remaining,
+                    reset_after, retry_after, wire,
+                )
+            )
+        return results
 
     # ------------------------------------------------------------------ #
 

@@ -71,6 +71,24 @@ class BatchingEngine:
         # Serializes device access with native transports that drive the
         # same limiter from their own threads (server/native_redis.py).
         self.limiter_lock = threading.Lock()
+        # Serving always wants the wire fast path (compact i32 whole-second
+        # outputs + degenerate-case certification) when the limiter offers
+        # it; fall back gracefully for duck-typed limiters that don't.
+        # Checked per method — a limiter may support wire on one but not
+        # the other.
+        import inspect
+
+        def wire_kw(fn):
+            try:
+                params = inspect.signature(fn).parameters
+            except (TypeError, ValueError):
+                return {}
+            return {"wire": True} if "wire" in params else {}
+
+        self._wire_kw = wire_kw(limiter.rate_limit_batch)
+        self._wire_many_kw = wire_kw(
+            getattr(limiter, "rate_limit_many", None)
+        )
         self.batch_size = batch_size
         self.max_linger_s = max_linger_us / 1e6
         self.cleanup_policy = cleanup_policy
@@ -177,7 +195,8 @@ class BatchingEngine:
                             now_ns,
                         )
                         for window in windows
-                    ]
+                    ],
+                    **self._wire_many_kw,
                 )
 
         try:
@@ -215,6 +234,7 @@ class BatchingEngine:
                     [r.period for r in requests],
                     [r.quantity for r in requests],
                     now_ns,
+                    **self._wire_kw,
                 )
 
         try:
@@ -232,7 +252,8 @@ class BatchingEngine:
 
     @staticmethod
     def _complete(batch, result) -> None:
-        """Resolve each request's future from its BatchResult row."""
+        """Resolve each request's future from its batch-result row."""
+        wire = hasattr(result, "reset_after_s")
         for i, (_, fut) in enumerate(batch):
             if fut.done():
                 continue
@@ -241,6 +262,17 @@ class BatchingEngine:
                 fut.set_exception(
                     ThrottleError(
                         STATUS_MESSAGES.get(status, "internal error")
+                    )
+                )
+            elif wire:
+                # Compact kernel output is already whole seconds.
+                fut.set_result(
+                    ThrottleResponse(
+                        allowed=bool(result.allowed[i]),
+                        limit=int(result.limit[i]),
+                        remaining=int(result.remaining[i]),
+                        reset_after=int(result.reset_after_s[i]),
+                        retry_after=int(result.retry_after_s[i]),
                     )
                 )
             else:

@@ -43,6 +43,7 @@ class HttpTransport:
         self.engine = engine
         self.metrics = metrics
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -59,7 +60,21 @@ class HttpTransport:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # Drop open keep-alive connections (the reference aborts its
+            # transport tasks, main.rs:154-169); Server.wait_closed()
+            # (3.12+) would otherwise wait on idle handlers forever.
+            # Cancel in a retry loop: a handler task created just before
+            # close() may not have registered itself yet on the first pass.
+            while True:
+                for task in list(self._conn_tasks):
+                    task.cancel()
+                try:
+                    await asyncio.wait_for(
+                        self._server.wait_closed(), timeout=0.2
+                    )
+                    return
+                except asyncio.TimeoutError:
+                    continue
 
     @property
     def bound_port(self) -> int:
@@ -68,6 +83,8 @@ class HttpTransport:
     # ------------------------------------------------------------------ #
 
     async def _handle_connection(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
         try:
             while True:
                 request = await self._read_request(reader)
@@ -92,9 +109,12 @@ class HttpTransport:
             BrokenPipeError,
         ):
             pass
+        except asyncio.CancelledError:
+            pass  # server shutdown dropped the connection
         except Exception:
             log.exception("HTTP connection error")
         finally:
+            self._conn_tasks.discard(task)
             writer.close()
             try:
                 await writer.wait_closed()

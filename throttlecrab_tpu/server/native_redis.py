@@ -173,6 +173,9 @@ class NativeRedisTransport:
         results = np.zeros(5 * n, np.int64)
         try:
             with self.limiter_lock:
+                # wire=True: compact i32 whole-second outputs straight off
+                # the device — the RESP/HTTP reply units — plus the
+                # degenerate-case kernel compiled out when certifiable.
                 res = self.limiter.rate_limit_batch(
                     keys,
                     p[0 : 4 * n : 4],
@@ -180,14 +183,15 @@ class NativeRedisTransport:
                     p[2 : 4 * n : 4],
                     p[3 : 4 * n : 4],
                     now_ns,
+                    wire=True,
                 )
             status = np.ascontiguousarray(res.status, np.uint8)
             out = results.reshape(n, 5)
             out[:, 0] = res.allowed
             out[:, 1] = res.limit
             out[:, 2] = res.remaining
-            out[:, 3] = res.reset_after_ns // NS_PER_SEC
-            out[:, 4] = res.retry_after_ns // NS_PER_SEC
+            out[:, 3] = res.reset_after_s
+            out[:, 4] = res.retry_after_s
         except Exception:
             log.exception("native redis decide failed")
             status = np.full(n, STATUS_INTERNAL, np.uint8)

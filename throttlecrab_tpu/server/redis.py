@@ -48,6 +48,7 @@ class RedisTransport:
         self.engine = engine
         self.metrics = metrics
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -64,7 +65,22 @@ class RedisTransport:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # Drop open connections like the reference's abort_all
+            # (main.rs:154-169): Server.wait_closed() (3.12+) waits for
+            # every handler, and an idle connection would otherwise hold
+            # shutdown hostage for the 5-minute read timeout.  Cancel in a
+            # retry loop: a handler task created just before close() may
+            # not have registered itself yet when the first pass runs.
+            while True:
+                for task in list(self._conn_tasks):
+                    task.cancel()
+                try:
+                    await asyncio.wait_for(
+                        self._server.wait_closed(), timeout=0.2
+                    )
+                    return
+                except asyncio.TimeoutError:
+                    continue
 
     @property
     def bound_port(self) -> int:
@@ -74,6 +90,8 @@ class RedisTransport:
 
     async def _handle_connection(self, reader, writer) -> None:
         """redis/mod.rs:85-149: read → accumulate → parse → dispatch."""
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
         buffer = b""
         parser = RespParser()
         try:
@@ -116,9 +134,12 @@ class RedisTransport:
                     break
         except (ConnectionResetError, BrokenPipeError):
             pass
+        except asyncio.CancelledError:
+            pass  # server shutdown dropped the connection
         except Exception:
             log.exception("Redis connection error")
         finally:
+            self._conn_tasks.discard(task)
             writer.close()
             try:
                 await writer.wait_closed()

@@ -85,6 +85,40 @@ class BatchResult:
     status: np.ndarray
 
 
+@dataclass
+class WireBatchResult:
+    """Per-request outcomes in wire units, from the compact kernel output.
+
+    reset_after_s / retry_after_s are whole seconds and remaining saturates
+    at i32::MAX — exactly what every transport emits (the reference
+    truncates Durations to seconds at the type boundary, types.rs:87-97,
+    and its gRPC proto is int32, throttlecrab.proto:15-21).  Fetching i32
+    seconds instead of i64 nanoseconds halves device→host bytes per
+    decision.
+    """
+
+    allowed: np.ndarray
+    limit: np.ndarray
+    remaining: np.ndarray
+    reset_after_s: np.ndarray
+    retry_after_s: np.ndarray
+    status: np.ndarray
+
+
+def has_degenerate(valid, emission, tolerance, quantity) -> bool:
+    """True when any valid request needs the kernel's degenerate-case
+    machinery: quantity-0 probes, burst-1 (tolerance 0), or zero emission
+    intervals.  When absent the engine compiles it out (`with_degen=False`,
+    ~40% less VPU work) — certified per batch, so correctness never
+    depends on traffic shape."""
+    return bool(
+        np.any(
+            valid
+            & ((emission == 0) | (tolerance == 0) | (quantity == 0))
+        )
+    )
+
+
 def prepare_batch(n, max_burst, count_per_period, period, quantity):
     """Broadcast request params to length n, validate, derive GCRA params.
 
@@ -230,15 +264,24 @@ class TpuRateLimiter(ScalarCompatMixin):
         period,
         quantity,
         now_ns: int,
+        wire: bool = False,
     ) -> BatchResult:
         """Decide a batch of requests at one server timestamp.
 
         `keys` is a sequence of hashable keys (str/bytes); the numeric
         parameters broadcast to its length.  `now_ns` must be >= 0.
+
+        `wire=True` takes the serving fast path: compact i32 whole-second
+        outputs (returns WireBatchResult) and the degenerate-case kernel
+        machinery compiled out whenever this batch provably has no
+        quantity-0 / burst-1 / zero-emission request.
         """
         (n, max_burst, quantity, emission, tolerance, status, valid,
          slots, rank0, is_last0, rounds) = self._prepare_one(
             keys, max_burst, count_per_period, period, quantity, now_ns
+        )
+        with_degen = not wire or has_degenerate(
+            valid, emission, tolerance, quantity
         )
 
         pad = max(self.MIN_PAD, 1 << (n - 1).bit_length())
@@ -272,7 +315,8 @@ class TpuRateLimiter(ScalarCompatMixin):
             else:
                 rank, is_last = segment_info(slots_p, valid_p)
             out_dev = self.table.check_batch(
-                slots_p, rank, is_last, em_p, tol_p, q_p, valid_p, now_ns
+                slots_p, rank, is_last, em_p, tol_p, q_p, valid_p, now_ns,
+                with_degen=with_degen, compact=wire,
             )
             # One device→host fetch per round; rounds beyond 0 are rare.
             out = np.asarray(out_dev)[:, :n]
@@ -281,9 +325,19 @@ class TpuRateLimiter(ScalarCompatMixin):
             reset_after[mask] = out[2][mask]
             retry_after[mask] = out[3][mask]
 
+        limit = np.where(valid, max_burst, 0)
+        if wire:
+            return WireBatchResult(
+                allowed=allowed,
+                limit=limit,
+                remaining=remaining,
+                reset_after_s=reset_after,
+                retry_after_s=retry_after,
+                status=status,
+            )
         return BatchResult(
             allowed=allowed,
-            limit=np.where(valid, max_burst, 0),
+            limit=limit,
             remaining=remaining,
             reset_after_ns=reset_after,
             retry_after_ns=retry_after,
@@ -328,18 +382,21 @@ class TpuRateLimiter(ScalarCompatMixin):
                 slots, rank0, is_last0, rounds)
 
     @staticmethod
-    def _error_result(n, status_code=STATUS_INTERNAL) -> BatchResult:
+    def _error_result(n, status_code=STATUS_INTERNAL, wire=False):
         """All-requests-failed result (engine maps status → error)."""
+        zeros = np.zeros(n, np.int64)
+        status = np.full(n, status_code, np.uint8)
+        if wire:
+            return WireBatchResult(
+                allowed=np.zeros(n, bool), limit=zeros, remaining=zeros,
+                reset_after_s=zeros, retry_after_s=zeros, status=status,
+            )
         return BatchResult(
-            allowed=np.zeros(n, bool),
-            limit=np.zeros(n, np.int64),
-            remaining=np.zeros(n, np.int64),
-            reset_after_ns=np.zeros(n, np.int64),
-            retry_after_ns=np.zeros(n, np.int64),
-            status=np.full(n, status_code, np.uint8),
+            allowed=np.zeros(n, bool), limit=zeros, remaining=zeros,
+            reset_after_ns=zeros, retry_after_ns=zeros, status=status,
         )
 
-    def rate_limit_many(self, batches) -> list:
+    def rate_limit_many(self, batches, wire: bool = False) -> list:
         """Decide K whole batches in ONE device launch (gcra_scan).
 
         `batches` is a list of (keys, max_burst, count_per_period, period,
@@ -356,10 +413,11 @@ class TpuRateLimiter(ScalarCompatMixin):
         if not batches:
             return []
         if len(batches) == 1:
-            return [self.rate_limit_batch(*batches[0])]
+            return [self.rate_limit_batch(*batches[0], wire=wire)]
 
         prepared = []
         width = self.MIN_PAD
+        any_degen = False
         for keys, max_burst, count_per_period, period, quantity, now_ns in (
             batches
         ):
@@ -378,14 +436,17 @@ class TpuRateLimiter(ScalarCompatMixin):
                 failed = False
                 for b in batches:
                     if failed:
-                        out.append(self._error_result(len(b[0])))
+                        out.append(self._error_result(len(b[0]), wire=wire))
                         continue
                     try:
-                        out.append(self.rate_limit_batch(*b))
+                        out.append(self.rate_limit_batch(*b, wire=wire))
                     except Exception:
                         failed = True
-                        out.append(self._error_result(len(b[0])))
+                        out.append(self._error_result(len(b[0]), wire=wire))
                 return out
+            any_degen = any_degen or has_degenerate(
+                valid, emission, tolerance, quantity
+            )
             prepared.append(
                 (n, slots, rank, is_last, emission, tolerance, quantity,
                  valid, now_ns, max_burst, status)
@@ -418,7 +479,8 @@ class TpuRateLimiter(ScalarCompatMixin):
 
         out = np.asarray(
             self.table.check_many(
-                slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s
+                slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s,
+                with_degen=not wire or any_degen, compact=wire,
             )
         )
 
@@ -427,16 +489,28 @@ class TpuRateLimiter(ScalarCompatMixin):
                 valid, now_ns, max_burst, status) in enumerate(prepared):
             o = out[j, :, :n]
             mask = valid_s[j, :n]
-            results.append(
-                BatchResult(
-                    allowed=(o[0] != 0) & mask,
-                    limit=np.where(valid, max_burst, 0),
-                    remaining=np.where(mask, o[1], 0),
-                    reset_after_ns=np.where(mask, o[2], 0),
-                    retry_after_ns=np.where(mask, o[3], 0),
-                    status=status,
-                )
+            fields = dict(
+                allowed=(o[0] != 0) & mask,
+                limit=np.where(valid, max_burst, 0),
+                remaining=np.where(mask, o[1], 0),
+                status=status,
             )
+            if wire:
+                results.append(
+                    WireBatchResult(
+                        reset_after_s=np.where(mask, o[2], 0),
+                        retry_after_s=np.where(mask, o[3], 0),
+                        **fields,
+                    )
+                )
+            else:
+                results.append(
+                    BatchResult(
+                        reset_after_ns=np.where(mask, o[2], 0),
+                        retry_after_ns=np.where(mask, o[3], 0),
+                        **fields,
+                    )
+                )
         return results
 
     # ------------------------------------------------------------------ #
