@@ -98,6 +98,13 @@ struct Response {
     std::string payload;
 };
 
+// Per-connection response-queue backpressure: a stalled driver must not
+// let inline replies (PING floods) accumulate unboundedly behind an
+// unready THROTTLE slot, so past these caps the connection stops reading
+// until the queue drains below half.
+constexpr size_t OUT_SLOT_CAP = 16384;
+constexpr size_t OUT_BYTES_CAP = 1 << 20;
+
 struct Conn {
     int fd = -1;
     uint64_t gen = 0;
@@ -105,9 +112,12 @@ struct Conn {
     std::string wbuf;
     std::deque<Slot> slots;   // response order; front() has seq slot_base
     uint64_t slot_base = 0;   // seq of slots.front()
+    size_t slots_bytes = 0;   // queued payload bytes across slots
     int64_t last_activity_ms = 0;
     bool closing = false;     // close once wbuf drains
     bool draining = false;    // close-after slot enqueued: stop parsing
+    bool rd_closed = false;   // client half-closed; flush remaining slots
+    bool out_paused = false;  // response queue over cap: stop reading
     bool want_write = false;
 };
 
@@ -459,14 +469,36 @@ struct WireServer {
         conns.erase(it);
     }
 
+    // Recompute this connection's epoll interest from its state: read
+    // unless globally paused / per-conn output-paused / half-closed.
+    void rearm(Conn& c) {
+        epoll_event ev{};
+        const bool want_read = !paused && !c.out_paused && !c.rd_closed;
+        ev.events = (want_read ? EPOLLIN : 0u) |
+                    (c.want_write ? EPOLLOUT : 0u);
+        ev.data.fd = c.fd;
+        epoll_ctl(epoll_fd, EPOLL_CTL_MOD, c.fd, &ev);
+    }
+
     void set_reading(bool enable) {
-        for (auto& [fd, c] : conns) {
-            epoll_event ev{};
-            ev.events = (enable ? EPOLLIN : 0u) |
-                        (c.want_write ? EPOLLOUT : 0u);
-            ev.data.fd = fd;
-            epoll_ctl(epoll_fd, EPOLL_CTL_MOD, fd, &ev);
+        paused = !enable;
+        for (auto& [fd, c] : conns) rearm(c);
+    }
+
+    // Client half-closed its write side: no more input will arrive, but
+    // pending responses (pipelined THROTTLEs, a deferred QUIT +OK) must
+    // still be delivered before the connection drops — the asyncio
+    // backends answer everything parsed before seeing EOF, and so must we.
+    void half_close(int fd) {
+        auto it = conns.find(fd);
+        if (it == conns.end()) return;
+        Conn& c = it->second;
+        if (c.slots.empty() && c.wbuf.empty()) {
+            drop_conn(fd);
+            return;
         }
+        c.rd_closed = true;
+        rearm(c);
     }
 
     bool over_cap() {
@@ -479,6 +511,7 @@ struct WireServer {
         auto it = conns.find(fd);
         if (it == conns.end()) return;
         Conn& c = it->second;
+        if (c.rd_closed) return;
         if (c.draining || c.closing) {
             // A close-after slot is queued (QUIT, protocol error): no more
             // parsing, but keep consuming and discarding socket bytes —
@@ -489,7 +522,8 @@ struct WireServer {
                 if (r > 0) continue;
                 if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
                     return;
-                drop_conn(fd);  // EOF or error, matching the normal path
+                if (r == 0) half_close(fd);  // deliver pending, then drop
+                else drop_conn(fd);
                 return;
             }
         }
@@ -506,18 +540,17 @@ struct WireServer {
                 auto again = conns.find(fd);
                 if (again == conns.end() || &again->second != &c)
                     return;  // dropped (or rehashed after an erase)
-                if (c.closing || c.draining) return;
+                if (c.closing || c.draining || c.out_paused) return;
                 if (c.rbuf.size() > MAX_CONN_BUFFER) {
                     emit_inline(c, "-ERR request too large\r\n", true);
                     return;
                 }
                 if (over_cap()) {
-                    paused = true;
                     set_reading(false);
                     return;
                 }
             } else if (r == 0) {
-                drop_conn(fd);
+                half_close(fd);  // deliver pending responses, then drop
                 return;
             } else {
                 if (errno == EAGAIN || errno == EWOULDBLOCK) break;
@@ -573,16 +606,34 @@ struct WireServer {
         while (!c.slots.empty() && c.slots.front().ready) {
             Slot& s = c.slots.front();
             c.wbuf += s.payload;
+            c.slots_bytes -= s.payload.size();
             const bool close_after = s.close_after;
             c.slots.pop_front();
             c.slot_base++;
             if (close_after) {
                 c.closing = true;
                 c.slots.clear();
+                c.slots_bytes = 0;
                 break;
             }
         }
+        if (c.out_paused && c.slots.size() < OUT_SLOT_CAP / 2 &&
+            c.slots_bytes < OUT_BYTES_CAP / 2) {
+            c.out_paused = false;
+            rearm(c);
+        }
+        // Half-closed client with every response delivered: close once
+        // the write buffer drains (flush drops closing conns).
+        if (c.rd_closed && c.slots.empty()) c.closing = true;
         flush(c);
+    }
+
+    void note_slot_pressure(Conn& c) {
+        if (!c.out_paused && (c.slots.size() >= OUT_SLOT_CAP ||
+                              c.slots_bytes >= OUT_BYTES_CAP)) {
+            c.out_paused = true;
+            rearm(c);
+        }
     }
 
     // Append a ready (inline) response in arrival order.  Even though the
@@ -594,8 +645,10 @@ struct WireServer {
         s.ready = true;
         s.close_after = close_after;
         s.payload = std::move(payload);
+        c.slots_bytes += s.payload.size();
         c.slots.push_back(std::move(s));
         if (close_after) c.draining = true;
+        note_slot_pressure(c);
         pump_slots(c);
     }
 
@@ -604,6 +657,7 @@ struct WireServer {
     uint64_t reserve_slot(Conn& c) {
         const uint64_t seq = c.slot_base + c.slots.size();
         c.slots.emplace_back();
+        note_slot_pressure(c);
         return seq;
     }
 
@@ -838,10 +892,7 @@ struct WireServer {
         bool want = !c.wbuf.empty();
         if (want != c.want_write) {
             c.want_write = want;
-            epoll_event ev{};
-            ev.events = EPOLLIN | (want ? EPOLLOUT : 0);
-            ev.data.fd = c.fd;
-            epoll_ctl(epoll_fd, EPOLL_CTL_MOD, c.fd, &ev);
+            rearm(c);
         }
         if (c.wbuf.empty() && c.closing) drop_conn(c.fd);
     }
@@ -856,7 +907,6 @@ struct WireServer {
             std::unique_lock<std::mutex> lk(q_mu);
             if (queue.size() < queue_cap / 2) {
                 lk.unlock();
-                paused = false;
                 set_reading(true);
             }
         }
@@ -879,6 +929,7 @@ struct WireServer {
             if (idx >= c.slots.size()) continue;
             Slot& s = c.slots[idx];
             s.payload = std::move(r.payload);
+            c.slots_bytes += s.payload.size();
             s.close_after = r.close_after;
             s.ready = true;
             if (touched.empty() || touched.back() != r.fd)

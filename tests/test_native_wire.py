@@ -260,6 +260,49 @@ def test_native_quit_waits_for_pipelined_throttle():
     assert data.endswith(b"+OK\r\n")
 
 
+def test_native_half_close_still_delivers_pipelined_responses():
+    """Client pipelines THROTTLE+THROTTLE+QUIT then shutdown(SHUT_WR)
+    (printf | nc style): all responses and the +OK must still arrive —
+    EOF with pending slots must not drop the connection early."""
+    import socket as socket_mod
+
+    async def main():
+        transport, _ = make_transport()
+        await transport.start()
+        loop = __import__("asyncio").get_running_loop()
+
+        def client():
+            s = socket_mod.create_connection(
+                ("127.0.0.1", transport.bound_port), 5
+            )
+            s.sendall(
+                _frame("THROTTLE", "hc1", "10", "100", "60")
+                + _frame("THROTTLE", "hc2", "10", "100", "60")
+                + _frame("QUIT")
+            )
+            s.shutdown(socket_mod.SHUT_WR)  # half-close before reading
+            s.settimeout(5)
+            data = b""
+            while True:
+                try:
+                    chunk = s.recv(4096)
+                except socket_mod.timeout:
+                    break
+                if not chunk:
+                    break
+                data += chunk
+            s.close()
+            return data
+
+        data = await loop.run_in_executor(None, client)
+        await transport.stop()
+        return data
+
+    data = asyncio.run(main())
+    assert data.count(b"*5\r\n:1\r\n") == 2
+    assert data.endswith(b"+OK\r\n")
+
+
 def test_native_null_bulk_arguments_rejected():
     async def main():
         transport, _ = make_transport()

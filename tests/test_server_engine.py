@@ -60,6 +60,23 @@ def test_actor_invariant_exactly_burst_allowed():
     assert all(allowed[:10]) and not any(allowed[10:])
 
 
+def test_actor_invariant_holds_across_batches():
+    """Same 20-tasks/burst-10 invariant, but with batch_size=4 so the
+    wave spans several device launches (and the scan path): exactly 10
+    allowed, still in arrival order."""
+
+    async def main():
+        engine, _ = make_engine(batch_size=4, max_linger_us=500)
+        results = await asyncio.gather(
+            *[engine.throttle(req(burst=10, period=3600)) for _ in range(20)]
+        )
+        return [r.allowed for r in results]
+
+    allowed = run(main())
+    assert sum(allowed) == 10
+    assert all(allowed[:10]) and not any(allowed[10:])
+
+
 def test_full_batch_flushes_without_linger():
     async def main():
         engine, _ = make_engine(batch_size=4, max_linger_us=10_000_000)

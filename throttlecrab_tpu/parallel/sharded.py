@@ -47,6 +47,7 @@ from ..tpu.limiter import (
     param_rounds,
     prepare_batch,
     segment_info,
+    sequential_fallback,
 )
 
 AXIS = "shard"
@@ -576,26 +577,12 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
             prepared.append(prep)
             width = max(width, slots.shape[1])
         if fallback:
-            # Errors are isolated per batch — earlier batches' decisions
-            # are already committed on-device and must still be delivered.
             # Re-deciding already-prepared batches is safe: prep only
             # resolves slots (idempotent), no device writes happened yet.
-            out = []
-            failed = False
-            for b in batches:
-                if failed:
-                    out.append(
-                        TpuRateLimiter._error_result(len(b[0]), wire=wire)
-                    )
-                    continue
-                try:
-                    out.append(self.rate_limit_batch(*b, wire=wire))
-                except Exception:
-                    failed = True
-                    out.append(
-                        TpuRateLimiter._error_result(len(b[0]), wire=wire)
-                    )
-            return out
+            return sequential_fallback(
+                batches, self.rate_limit_batch,
+                TpuRateLimiter._error_result, wire,
+            )
 
         D = self.n_shards
         K = len(prepared)

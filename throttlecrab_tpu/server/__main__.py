@@ -161,6 +161,17 @@ class TransportFailure(RuntimeError):
 
 
 def main(argv=None) -> int:
+    # THROTTLECRAB_PLATFORM pins the jax backend (e.g. "cpu" for CPU-only
+    # deployments and the out-of-process tests).  Must happen before any
+    # device query, and in-process — accelerator PJRT plugins loaded from
+    # sitecustomize can re-point JAX after the environment is read.
+    import os
+
+    platform = os.environ.get("THROTTLECRAB_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     try:
         config = Config.from_env_and_args(argv)
     except ConfigError as e:

@@ -22,6 +22,7 @@ from typing import Optional
 
 from .engine import BatchingEngine, ThrottleError
 from .metrics import Metrics
+from .transport_base import ConnTrackingMixin
 from .types import ThrottleRequest
 
 log = logging.getLogger("throttlecrab.http")
@@ -30,7 +31,7 @@ MAX_HEADER_BYTES = 16 * 1024
 MAX_BODY_BYTES = 1 << 20
 
 
-class HttpTransport:
+class HttpTransport(ConnTrackingMixin):
     """`POST /throttle` + `GET /health` + `GET /metrics`."""
 
     name = "http"
@@ -43,7 +44,7 @@ class HttpTransport:
         self.engine = engine
         self.metrics = metrics
         self._server: Optional[asyncio.AbstractServer] = None
-        self._conn_tasks: set = set()
+        self._init_conn_tracking()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -59,22 +60,7 @@ class HttpTransport:
 
     async def stop(self) -> None:
         if self._server is not None:
-            self._server.close()
-            # Drop open keep-alive connections (the reference aborts its
-            # transport tasks, main.rs:154-169); Server.wait_closed()
-            # (3.12+) would otherwise wait on idle handlers forever.
-            # Cancel in a retry loop: a handler task created just before
-            # close() may not have registered itself yet on the first pass.
-            while True:
-                for task in list(self._conn_tasks):
-                    task.cancel()
-                try:
-                    await asyncio.wait_for(
-                        self._server.wait_closed(), timeout=0.2
-                    )
-                    return
-                except asyncio.TimeoutError:
-                    continue
+            await self._stop_dropping_conns(self._server)
 
     @property
     def bound_port(self) -> int:
@@ -83,8 +69,7 @@ class HttpTransport:
     # ------------------------------------------------------------------ #
 
     async def _handle_connection(self, reader, writer) -> None:
-        task = asyncio.current_task()
-        self._conn_tasks.add(task)
+        task = self._track_conn()
         try:
             while True:
                 request = await self._read_request(reader)
@@ -114,12 +99,15 @@ class HttpTransport:
         except Exception:
             log.exception("HTTP connection error")
         finally:
-            self._conn_tasks.discard(task)
             writer.close()
             try:
+                # Untrack only after the last await: stop()'s cancel loop
+                # must still reach a handler stuck in wait_closed.
                 await writer.wait_closed()
             except Exception:
                 pass
+            finally:
+                self._untrack_conn(task)
 
     async def _read_request(self, reader):
         """Parse one HTTP/1.1 request; None on clean EOF."""

@@ -17,6 +17,7 @@ from typing import Optional
 
 from .engine import BatchingEngine, ThrottleError
 from .metrics import Metrics
+from .transport_base import ConnTrackingMixin
 from .resp import (
     Array,
     BulkString,
@@ -35,7 +36,7 @@ MAX_BUFFER_SIZE = 64 * 1024  # redis/mod.rs:83
 IDLE_TIMEOUT_SECS = 300  # redis/mod.rs:99
 
 
-class RedisTransport:
+class RedisTransport(ConnTrackingMixin):
     """RESP TCP accept loop + command dispatch."""
 
     name = "redis"
@@ -48,7 +49,7 @@ class RedisTransport:
         self.engine = engine
         self.metrics = metrics
         self._server: Optional[asyncio.AbstractServer] = None
-        self._conn_tasks: set = set()
+        self._init_conn_tracking()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -64,23 +65,7 @@ class RedisTransport:
 
     async def stop(self) -> None:
         if self._server is not None:
-            self._server.close()
-            # Drop open connections like the reference's abort_all
-            # (main.rs:154-169): Server.wait_closed() (3.12+) waits for
-            # every handler, and an idle connection would otherwise hold
-            # shutdown hostage for the 5-minute read timeout.  Cancel in a
-            # retry loop: a handler task created just before close() may
-            # not have registered itself yet when the first pass runs.
-            while True:
-                for task in list(self._conn_tasks):
-                    task.cancel()
-                try:
-                    await asyncio.wait_for(
-                        self._server.wait_closed(), timeout=0.2
-                    )
-                    return
-                except asyncio.TimeoutError:
-                    continue
+            await self._stop_dropping_conns(self._server)
 
     @property
     def bound_port(self) -> int:
@@ -90,8 +75,7 @@ class RedisTransport:
 
     async def _handle_connection(self, reader, writer) -> None:
         """redis/mod.rs:85-149: read → accumulate → parse → dispatch."""
-        task = asyncio.current_task()
-        self._conn_tasks.add(task)
+        task = self._track_conn()
         buffer = b""
         parser = RespParser()
         try:
@@ -139,12 +123,15 @@ class RedisTransport:
         except Exception:
             log.exception("Redis connection error")
         finally:
-            self._conn_tasks.discard(task)
             writer.close()
             try:
+                # Untrack only after the last await: stop()'s cancel loop
+                # must still reach a handler stuck in wait_closed.
                 await writer.wait_closed()
             except Exception:
                 pass
+            finally:
+                self._untrack_conn(task)
 
     # ------------------------------------------------------------------ #
 

@@ -166,6 +166,30 @@ def param_rounds(rounds, slots, positions, emission, tolerance, quantity):
     return rounds
 
 
+def sequential_fallback(batches, decide_fn, error_result_fn, wire):
+    """Decide a rate_limit_many window batch-by-batch when the scan path
+    cannot express it (a key changed parameters mid-batch — the multi-round
+    sub-protocol interleaves with later sub-batches in ways one scan can't;
+    rare, and exactness beats speed there).
+
+    Errors are isolated per batch: earlier batches' decisions are already
+    committed on-device and must still be delivered; later batches after a
+    failure return all-internal-error results.
+    """
+    out = []
+    failed = False
+    for b in batches:
+        if failed:
+            out.append(error_result_fn(len(b[0]), wire=wire))
+            continue
+        try:
+            out.append(decide_fn(*b, wire=wire))
+        except Exception:
+            failed = True
+            out.append(error_result_fn(len(b[0]), wire=wire))
+    return out
+
+
 class ScalarCompatMixin:
     """Scalar `rate_limit` (the reference library API) over a batch engine.
 
@@ -426,24 +450,9 @@ class TpuRateLimiter(ScalarCompatMixin):
                 keys, max_burst, count_per_period, period, quantity, now_ns
             )
             if rounds.any():
-                # A key changed parameters mid-batch: the multi-round
-                # sub-protocol interleaves with later sub-batches in ways a
-                # single scan cannot express, so decide the whole window
-                # sequentially (rare; exactness beats speed here).  Errors
-                # are isolated per batch — earlier batches' decisions are
-                # already committed on-device and must still be delivered.
-                out = []
-                failed = False
-                for b in batches:
-                    if failed:
-                        out.append(self._error_result(len(b[0]), wire=wire))
-                        continue
-                    try:
-                        out.append(self.rate_limit_batch(*b, wire=wire))
-                    except Exception:
-                        failed = True
-                        out.append(self._error_result(len(b[0]), wire=wire))
-                return out
+                return sequential_fallback(
+                    batches, self.rate_limit_batch, self._error_result, wire
+                )
             any_degen = any_degen or has_degenerate(
                 valid, emission, tolerance, quantity
             )
