@@ -1,0 +1,287 @@
+"""Cross-process cluster sharding: limits must hold across process
+boundaries (SURVEY §2.4's DCN obligation; the reference's answer was
+client-side sharding, README.md:247-249 — here the server does it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from throttlecrab_tpu.parallel.cluster import (
+    ClusterLimiter,
+    decode_batch,
+    decode_reply,
+    encode_batch,
+    encode_reply,
+    node_of_key,
+)
+from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+NS = 1_000_000_000
+T0 = 1_700_000_000 * NS
+
+
+# ------------------------------------------------------------- protocol #
+
+
+def test_frame_roundtrip():
+    keys = [b"alpha", b"b" * 300, b"", "ünïcode".encode()]
+    params = [(10, 100, 60, 1), (5, 50, 30, 2), (1, 1, 1, 0),
+              (2 ** 40, 2 ** 41, 2 ** 42, 2 ** 43)]
+    frame = encode_batch(keys, params, T0)
+    # strip header
+    body = frame[5:]
+    dkeys, dparams, dnow = decode_batch(body)
+    assert dkeys == keys
+    assert dparams.tolist() == [list(p) for p in params]
+    assert dnow == T0
+
+
+def test_reply_roundtrip():
+    frame = encode_reply(
+        np.array([0, 2, 0], np.uint8),
+        np.array([True, False, False]),
+        np.array([10, 0, 5], np.int64),
+        np.array([9, 0, 0], np.int64),
+        np.array([6 * NS, 0, 2 ** 62], np.int64),
+        np.array([0, 0, 3 * NS], np.int64),
+    )
+    rep = decode_reply(frame[5:])
+    assert rep["status"].tolist() == [0, 2, 0]
+    assert rep["allowed"].tolist() == [1, 0, 0]
+    assert rep["reset_ns"][2] == 2 ** 62
+
+
+def test_malformed_frames_rejected():
+    from throttlecrab_tpu.parallel.cluster import (
+        ClusterProtocolError,
+        _HDR,
+        _REP_HEAD,
+        _REQ_HEAD,
+    )
+    import struct
+
+    # Attacker-controlled count must not size an allocation: n=2^32-1 in a
+    # tiny frame.
+    with pytest.raises(ClusterProtocolError):
+        decode_batch(_REQ_HEAD.pack(0xFFFFFFFF, T0))
+    with pytest.raises(ClusterProtocolError):
+        decode_reply(_REP_HEAD.pack(0xFFFFFFFF))
+    # Truncated reply body.
+    with pytest.raises(ClusterProtocolError):
+        decode_reply(_REP_HEAD.pack(2) + b"\x00" * 10)
+    # Item overrunning the frame.
+    bad = _REQ_HEAD.pack(1, T0) + struct.pack("<H", 500) + b"k"
+    with pytest.raises(ClusterProtocolError):
+        decode_batch(bad)
+    assert _HDR.size == 5
+
+
+def test_oversized_key_fails_only_itself():
+    local = TpuRateLimiter(capacity=64)
+    cl = ClusterLimiter(local, ["127.0.0.1:1"], 0)
+    keys = ["ok1", "x" * 70_000, "ok2"]
+    res = cl.rate_limit_batch(keys, 5, 100, 60, 1, T0)
+    assert res.allowed.tolist() == [True, False, True]
+    assert res.status[1] != 0 and res.status[0] == 0 and res.status[2] == 0
+
+
+def test_node_routing_stable_and_decorrelated():
+    keys = [b"user:%d" % i for i in range(2000)]
+    owners = [node_of_key(k, 4) for k in keys]
+    # Deterministic.
+    assert owners == [node_of_key(k, 4) for k in keys]
+    # Roughly balanced.
+    counts = np.bincount(owners, minlength=4)
+    assert counts.min() > 300
+    # Decorrelated from the intra-node device-shard hash: keys owned by
+    # node 0 of 2 must still spread over 2 local shards.
+    from throttlecrab_tpu.parallel.sharded import shard_of_key
+
+    node0 = [k for k in keys if node_of_key(k, 2) == 0]
+    local = np.bincount([shard_of_key(k, 2) for k in node0], minlength=2)
+    assert local.min() > len(node0) // 4
+
+
+# -------------------------------------------------- single-node passthru #
+
+
+def test_single_node_cluster_is_passthrough():
+    plain = TpuRateLimiter(capacity=256)
+    local = TpuRateLimiter(capacity=256)
+    cl = ClusterLimiter(local, ["127.0.0.1:1"], 0)  # only node: no RPC
+    keys = [f"k{i % 20}" for i in range(64)]
+    a = plain.rate_limit_batch(keys, 5, 100, 60, 1, T0)
+    b = cl.rate_limit_batch(keys, 5, 100, 60, 1, T0)
+    assert a.allowed.tolist() == b.allowed.tolist()
+    assert a.remaining.tolist() == b.remaining.tolist()
+    assert a.reset_after_ns.tolist() == b.reset_after_ns.tolist()
+    # wire path too
+    w = cl.rate_limit_batch(keys, 5, 100, 60, 1, T0 + NS, wire=True)
+    assert w.reset_after_s.dtype == np.int64
+
+
+# ------------------------------------------------------- two processes #
+
+HTTP_A, HTTP_B = 28180, 28181
+RPC_A, RPC_B = 28190, 28191
+NODES = f"127.0.0.1:{RPC_A},127.0.0.1:{RPC_B}"
+
+
+def spawn_node(index: int, http_port: int):
+    env = dict(os.environ)
+    env["THROTTLECRAB_PLATFORM"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "throttlecrab_tpu.server",
+            "--http", "--http-port", str(http_port),
+            "--cluster-nodes", NODES, "--cluster-index", str(index),
+            "--store", "adaptive", "--log-level", "warn",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def wait_healthy(proc, port, deadline_s=120):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            pytest.fail(f"node exited early rc={proc.returncode}:\n{out}")
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=1
+            ) as r:
+                assert r.read() == b"OK"
+                return
+        except Exception:
+            time.sleep(0.5)
+    pytest.fail("node never became healthy")
+
+
+def throttle_via(port, key, burst=3):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/throttle",
+        data=json.dumps(
+            {"key": key, "max_burst": burst, "count_per_period": 10,
+             "period": 60}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def two_nodes():
+    a = spawn_node(0, HTTP_A)
+    b = spawn_node(1, HTTP_B)
+    try:
+        wait_healthy(a, HTTP_A)
+        wait_healthy(b, HTTP_B)
+        yield a, b
+    finally:
+        for p in (a, b):
+            if p.poll() is None:
+                p.terminate()
+        for p in (a, b):
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def key_owned_by(node_idx: int, prefix: str) -> str:
+    for i in range(10_000):
+        k = f"{prefix}:{i}"
+        if node_of_key(k.encode(), 2) == node_idx:
+            return k
+    raise AssertionError("no key found")
+
+
+def test_limits_hold_across_processes(two_nodes):
+    """Burst 3 on one key, driven through BOTH nodes' HTTP frontends:
+    exactly 3 allowed in total — the owner decides no matter which node
+    the client hit."""
+    key = key_owned_by(1, "xproc")  # owned by node B
+    results = [
+        throttle_via(HTTP_A, key)["allowed"],  # A forwards to B
+        throttle_via(HTTP_A, key)["allowed"],
+        throttle_via(HTTP_B, key)["allowed"],  # B decides locally
+        throttle_via(HTTP_A, key)["allowed"],
+        throttle_via(HTTP_B, key)["allowed"],
+    ]
+    assert results == [True, True, True, False, False]
+
+
+def test_both_directions_route(two_nodes):
+    """A key owned by node A driven via node B (reverse forwarding)."""
+    key = key_owned_by(0, "revproc")
+    results = [throttle_via(HTTP_B, key, burst=2)["allowed"]
+               for _ in range(3)]
+    assert results == [True, True, False]
+
+
+def test_remaining_consistent_across_frontends(two_nodes):
+    key = key_owned_by(1, "remproc")
+    r1 = throttle_via(HTTP_A, key, burst=5)
+    r2 = throttle_via(HTTP_B, key, burst=5)
+    r3 = throttle_via(HTTP_A, key, burst=5)
+    assert (r1["remaining"], r2["remaining"], r3["remaining"]) == (4, 3, 2)
+
+
+def test_bidirectional_concurrent_traffic_no_deadlock(two_nodes):
+    """Both frontends forwarding to each other simultaneously must not
+    deadlock: each node's reply production (its ClusterServer) only needs
+    the device lock, never the engine lock held across outbound RPCs.
+    Regression for the cross-node lock cycle."""
+    import concurrent.futures
+
+    key_a = key_owned_by(0, "bidiA")  # A-owned, driven via B
+    key_b = key_owned_by(1, "bidiB")  # B-owned, driven via A
+
+    t0 = time.time()
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        futs = []
+        for i in range(12):
+            futs.append(
+                pool.submit(throttle_via, HTTP_A, f"{key_b}:{i}", 100)
+            )
+            futs.append(
+                pool.submit(throttle_via, HTTP_B, f"{key_a}:{i}", 100)
+            )
+        results = [f.result(timeout=60) for f in futs]
+    elapsed = time.time() - t0
+    assert all(r["allowed"] for r in results)
+    # Well under the 30s RPC timeout a deadlock would burn per round.
+    assert elapsed < 20, f"bidirectional traffic took {elapsed:.1f}s"
+
+
+def test_peer_failure_isolated(two_nodes):
+    """Killing node B fails only B-owned keys on A; A-owned keys keep
+    deciding (a reference instance going down loses only its key range)."""
+    a, b = two_nodes
+    key_b = key_owned_by(1, "failproc")
+    key_a = key_owned_by(0, "okproc")
+    b.terminate()
+    b.wait(timeout=30)
+    # B-owned key via A → 500 (internal error), not a hang.
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        throttle_via(HTTP_A, key_b)
+    assert exc.value.code == 500
+    # A-owned key still fine.
+    assert throttle_via(HTTP_A, key_a)["allowed"] is True

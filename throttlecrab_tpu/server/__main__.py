@@ -102,6 +102,21 @@ async def run_server(config: Config) -> None:
     )
     log.info("starting rate limiter with %s store", config.store)
     limiter = create_limiter(config)
+    cluster_nodes = config.cluster_node_list()
+    if cluster_nodes:
+        # Multi-node deployment: every key has one owner node (salted
+        # stable hash); remote keys forward over the cluster RPC and
+        # limits hold globally (parallel/cluster.py).
+        from ..parallel.cluster import ClusterLimiter
+
+        log.info(
+            "cluster mode: node %d of %d (%s)",
+            config.cluster_index, len(cluster_nodes),
+            cluster_nodes[config.cluster_index],
+        )
+        limiter = ClusterLimiter(
+            limiter, cluster_nodes, config.cluster_index
+        )
     engine = BatchingEngine(
         limiter,
         batch_size=config.batch_size,
@@ -111,6 +126,24 @@ async def run_server(config: Config) -> None:
         profile_dir=config.profile_dir or None,
     )
     transports = build_transports(config, engine, metrics)
+    if cluster_nodes:
+        from ..parallel.cluster import ClusterServer
+
+        rpc_port = int(
+            cluster_nodes[config.cluster_index].rpartition(":")[2]
+        )
+        # The RPC listener decides on the local limiter under the
+        # cluster's device lock — NOT the engine's limiter_lock, which is
+        # held across outbound peer RPCs; sharing it would deadlock two
+        # nodes forwarding to each other.
+        transports.append(
+            ClusterServer(
+                config.cluster_bind_host,
+                rpc_port,
+                limiter.local,
+                limiter.device_lock,
+            )
+        )
 
     for transport in transports:
         await transport.start()

@@ -70,6 +70,13 @@ _SPEC = [
      "Number of devices to shard the bucket table over"),
     ("profile_dir", "THROTTLECRAB_PROFILE_DIR", "", str,
      "Directory for an xprof trace of the first launches (empty: off)"),
+    ("cluster_nodes", "THROTTLECRAB_CLUSTER_NODES", "", str,
+     "Comma-separated host:port cluster RPC addresses of every node "
+     "(same list on every node; empty: single-node)"),
+    ("cluster_index", "THROTTLECRAB_CLUSTER_INDEX", 0, int,
+     "This node's position in --cluster-nodes"),
+    ("cluster_bind_host", "THROTTLECRAB_CLUSTER_BIND_HOST", "0.0.0.0", str,
+     "Bind host for the cluster RPC listener"),
 ]
 
 
@@ -101,6 +108,9 @@ class Config:
     keymap: str = "auto"
     shards: int = 1
     profile_dir: str = ""
+    cluster_nodes: str = ""
+    cluster_index: int = 0
+    cluster_bind_host: str = "0.0.0.0"
 
     @classmethod
     def from_env_and_args(
@@ -149,6 +159,22 @@ class Config:
             )
         if self.shards < 1:
             raise ConfigError("shards must be >= 1")
+        nodes = self.cluster_node_list()
+        if nodes:
+            if not 0 <= self.cluster_index < len(nodes):
+                raise ConfigError(
+                    "cluster_index must index into cluster_nodes"
+                )
+            for addr in nodes:
+                host, _, port = addr.rpartition(":")
+                if not host or not port.isdigit():
+                    raise ConfigError(
+                        f"Invalid cluster node address: {addr!r} "
+                        "(expected host:port)"
+                    )
+
+    def cluster_node_list(self) -> List[str]:
+        return [a.strip() for a in self.cluster_nodes.split(",") if a.strip()]
 
     def enabled_transports(self) -> List[str]:
         out = []

@@ -28,7 +28,7 @@ from typing import Optional
 import numpy as np
 
 from ..native import get_wire_lib
-from ..tpu.limiter import STATUS_INTERNAL
+from ..tpu.limiter import STATUS_INTERNAL, limiter_uses_bytes_keys
 
 log = logging.getLogger("throttlecrab.redis.native")
 
@@ -163,7 +163,7 @@ class NativeRedisTransport:
         keys = [
             blob[offsets[i] : offsets[i + 1]] for i in range(n)
         ]
-        if not getattr(self.limiter.keymap, "BYTES_KEYS", False):
+        if not limiter_uses_bytes_keys(self.limiter):
             # Match the identity the str-keyed transports use, so one
             # client key maps to one bucket across HTTP/gRPC/RESP.
             # surrogateescape keeps arbitrary bytes unique and lossless.

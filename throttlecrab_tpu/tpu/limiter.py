@@ -166,6 +166,19 @@ def param_rounds(rounds, slots, positions, emission, tolerance, quantity):
     return rounds
 
 
+def limiter_uses_bytes_keys(limiter) -> bool:
+    """Whether a limiter's host keymap stores bytes keys (native backend)
+    or str keys (python backend).  Transports that receive raw bytes must
+    match the identity str-keyed transports use, or one client key becomes
+    two buckets.  Works across TpuRateLimiter (.keymap), the sharded
+    limiter (._bytes_keys), and cluster wrappers (delegated _bytes_keys).
+    """
+    km = getattr(limiter, "keymap", None)
+    if km is not None:
+        return bool(getattr(km, "BYTES_KEYS", False))
+    return bool(getattr(limiter, "_bytes_keys", False))
+
+
 def sequential_fallback(batches, decide_fn, error_result_fn, wire):
     """Decide a rate_limit_many window batch-by-batch when the scan path
     cannot express it (a key changed parameters mid-batch — the multi-round
