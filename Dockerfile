@@ -1,0 +1,42 @@
+# throttlecrab-tpu server image.
+#
+# Mirrors the reference's deployment surface (/root/reference/Dockerfile):
+# same ports, same THROTTLECRAB_* switches — but the runtime here is
+# Python/JAX plus a C++ wire layer built during the image build, so the
+# base is slim-python rather than scratch.
+#
+# On a TPU host, run with the TPU runtime mounted and drop
+# THROTTLECRAB_PLATFORM; on CPU-only hosts keep THROTTLECRAB_PLATFORM=cpu.
+
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ curl \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY throttlecrab_tpu ./throttlecrab_tpu
+COPY native ./native
+
+# Portable baseline arch: the .so baked at build time must run on any
+# deployment host, so no -march=native inside images.
+ENV THROTTLECRAB_NATIVE_CFLAGS="-O3 -march=x86-64-v2"
+
+RUN pip install --no-cache-dir jax numpy grpcio protobuf \
+    && pip install --no-cache-dir -e . \
+    # Build the native keymap + wire server now so startup is instant and
+    # a toolchain problem fails the image build, not the deployment.
+    && python -c "from throttlecrab_tpu.native import native_available, \
+wire_available; assert native_available() and wire_available()"
+
+# HTTP, gRPC, Redis/RESP
+EXPOSE 8080 8070 6379
+
+ENV THROTTLECRAB_HTTP=true
+ENV THROTTLECRAB_GRPC=true
+ENV THROTTLECRAB_REDIS=true
+ENV THROTTLECRAB_LOG_LEVEL=info
+ENV THROTTLECRAB_PLATFORM=cpu
+
+CMD ["python", "-m", "throttlecrab_tpu.server"]

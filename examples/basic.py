@@ -1,5 +1,8 @@
 """Embedded library use — the reference's examples/basic.rs equivalent."""
 
+import os.path as _p, sys as _s
+_s.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))
+
 import time
 
 import throttlecrab_tpu as tc

@@ -3,6 +3,9 @@
 Runs on whatever backend JAX provides (TPU if available, CPU otherwise).
 """
 
+import os.path as _p, sys as _s
+_s.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))
+
 import time
 
 import numpy as np

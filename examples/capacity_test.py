@@ -1,0 +1,64 @@
+"""Capacity-pressure demo: table growth, sweep reclamation, memory/key.
+
+The reference's examples/capacity_test.rs pushes unique keys through each
+store to show capacity behavior (docs/capacity-behavior.md).  The TPU
+table is dense SoA — 16 bytes of HBM per slot — so the interesting
+behavior is growth doubling (HashMap-style) and the expiry sweep
+vacating slots for the host keymap to reuse.
+
+Run: python examples/capacity_test.py [--cpu]
+"""
+
+from __future__ import annotations
+
+import os.path as _p, sys as _s
+_s.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))
+
+import sys
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+NS = 1_000_000_000
+T0 = 1_753_000_000 * NS
+
+
+def main() -> None:
+    limiter = TpuRateLimiter(capacity=1024, auto_grow=True)
+    print(f"initial capacity: {limiter.total_capacity} slots "
+          f"({limiter.total_capacity * 16 / 1024:.0f} KiB HBM)")
+
+    # 1. Push 10x the initial capacity of unique short-TTL keys.
+    n = 10_240
+    for start in range(0, n, 1024):
+        keys = [f"burst_key_{i}" for i in range(start, start + 1024)]
+        limiter.rate_limit_batch(keys, 10, 100, 60, 1, T0)  # 60 s period
+    print(f"after {n} unique keys: capacity={limiter.total_capacity}, "
+          f"live={len(limiter)}")
+
+    # 2. Everything expires after its TTL; one sweep vacates the slots.
+    freed = limiter.sweep(T0 + 3600 * NS)
+    print(f"sweep at +1h freed {freed} slots; live={len(limiter)}")
+
+    # 3. The vacated capacity is reused without further growth.
+    before = limiter.total_capacity
+    for start in range(0, n, 1024):
+        keys = [f"second_wave_{i}" for i in range(start, start + 1024)]
+        limiter.rate_limit_batch(keys, 10, 100, 60, 1, T0 + 3601 * NS)
+    print(f"second wave of {n} keys reused slots: capacity "
+          f"{before} -> {limiter.total_capacity} (no growth)")
+
+    hbm = limiter.total_capacity * 16
+    print(
+        f"\nmemory model: {hbm / 1024:.0f} KiB HBM for "
+        f"{limiter.total_capacity} slots (16 B/slot) + host keymap "
+        "(~60 B + key bytes per live key)"
+    )
+
+
+if __name__ == "__main__":
+    main()

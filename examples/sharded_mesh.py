@@ -5,6 +5,9 @@ Run CPU-hermetic with:
       python examples/sharded_mesh.py
 """
 
+import os.path as _p, sys as _s
+_s.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))
+
 import time
 
 import jax

@@ -1,0 +1,78 @@
+"""Cleanup-policy comparison on one workload — examples/store_comparison.rs.
+
+The reference compares PeriodicStore / ProbabilisticStore / AdaptiveStore
+throughput; here the three are *cleanup policies* over the same device
+table (tpu/cleanup.py preserves each one's trigger rules verbatim), so the
+comparison shows policy overhead and sweep cadence rather than separate
+store implementations.
+
+Run: python examples/store_comparison.py [--cpu]
+"""
+
+from __future__ import annotations
+
+import os.path as _p, sys as _s
+_s.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))
+
+import sys
+import time
+
+import numpy as np
+
+if "--cpu" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from throttlecrab_tpu.tpu.cleanup import (
+    AdaptivePolicy,
+    PeriodicPolicy,
+    ProbabilisticPolicy,
+)
+from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+NS = 1_000_000_000
+T0 = 1_753_000_000 * NS
+BATCH = 1024
+BATCHES = 48
+N_KEYS = 20_000
+
+
+def run(name: str, policy) -> None:
+    rng = np.random.default_rng(7)
+    limiter = TpuRateLimiter(capacity=1 << 15)
+    ids = rng.integers(0, N_KEYS, BATCH * BATCHES)
+    keys = [f"key_{int(i)}" for i in ids]
+    limiter.rate_limit_batch(keys[:BATCH], 100, 1000, 60, 1, T0)  # warm
+
+    sweeps = 0
+    freed_total = 0
+    start = time.perf_counter()
+    for b in range(BATCHES):
+        now = T0 + b * 30 * NS  # 30 s per batch: TTLs lapse mid-run
+        limiter.rate_limit_batch(
+            keys[b * BATCH : (b + 1) * BATCH], 100, 1000, 60, 1, now,
+            wire=True,
+        )
+        policy.record_ops(BATCH)
+        if policy.should_clean(now, len(limiter), limiter.total_capacity):
+            freed = limiter.sweep(now)
+            policy.after_sweep(now, freed, len(limiter))
+            sweeps += 1
+            freed_total += freed
+    dt = time.perf_counter() - start
+    print(
+        f"{name:>14}: {BATCH * BATCHES / dt:>12,.0f} decisions/s, "
+        f"{sweeps} sweeps, {freed_total} slots reclaimed, "
+        f"{len(limiter)} live"
+    )
+
+
+def main() -> None:
+    run("periodic", PeriodicPolicy(interval_ns=60 * NS))
+    run("probabilistic", ProbabilisticPolicy(probability=10))
+    run("adaptive", AdaptivePolicy())
+
+
+if __name__ == "__main__":
+    main()

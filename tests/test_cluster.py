@@ -285,3 +285,14 @@ def test_peer_failure_isolated(two_nodes):
     assert exc.value.code == 500
     # A-owned key still fine.
     assert throttle_via(HTTP_A, key_a)["allowed"] is True
+
+
+def test_unencodable_key_fails_only_itself():
+    """A lone surrogate outside U+DC80-DCFF (JSON can deliver one) cannot
+    cross the wire; it must fail individually, not 500 its batchmates."""
+    local = TpuRateLimiter(capacity=64)
+    cl = ClusterLimiter(local, ["127.0.0.1:1"], 0)
+    keys = ["good1", "\ud800bad", "good2"]
+    res = cl.rate_limit_batch(keys, 5, 100, 60, 1, T0)
+    assert res.allowed.tolist() == [True, False, True]
+    assert res.status[1] != 0 and res.status[0] == 0 and res.status[2] == 0

@@ -104,3 +104,48 @@ def test_native_keymap_items_export():
     slots, _, _, _ = km.resolve(keys, np.ones(3, bool))
     exported = dict(km.items())
     assert exported == {k: int(s) for k, s in zip(keys, slots)}
+
+
+@pytest.mark.parametrize(
+    "src_keymap,dst_keymap",
+    [("native", "python"), ("python", "native")],
+)
+def test_cross_backend_restore_preserves_key_identity(
+    tmp_path, src_keymap, dst_keymap
+):
+    """A snapshot taken with one keymap backend must restore into the
+    other with reachable buckets: native keymaps store str transport keys
+    as bytes, so restore translates identities (surrogateescape both
+    ways)."""
+    from throttlecrab_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    path = tmp_path / "snap.npz"
+    lim = TpuRateLimiter(capacity=256, keymap=src_keymap)
+    for _ in range(3):
+        lim.rate_limit("hot", 3, 10, 3600, 1, T0)  # exhaust via str key
+
+    save_snapshot(lim, path)
+    lim2 = TpuRateLimiter(capacity=256, keymap=dst_keymap)
+    assert load_snapshot(lim2, path, now_ns=T0 + NS) == 1
+    # The SAME str key must hit the restored bucket, not a fresh one.
+    allowed, _ = lim2.rate_limit("hot", 3, 10, 3600, 1, T0 + NS)
+    assert not allowed, "restored bucket unreachable: key identity lost"
+
+
+def test_snapshot_survives_lone_surrogate_key(tmp_path):
+    """One JSON-delivered lone-surrogate key must not lose the whole
+    snapshot; it round-trips via the per-key codec marker."""
+    path = tmp_path / "snap.npz"
+    lim = TpuRateLimiter(capacity=64)  # python keymap holds any str
+    weird = "\ud800weird"
+    lim.rate_limit(weird, 3, 10, 3600, 1, T0)
+    lim.rate_limit("normal", 3, 10, 3600, 1, T0)
+    assert save_snapshot(lim, path) == 2
+
+    lim2 = TpuRateLimiter(capacity=64)
+    assert load_snapshot(lim2, path, now_ns=T0 + NS) == 2
+    # Identity preserved: the same weird str hits the restored bucket.
+    _, r = lim2.rate_limit(weird, 3, 10, 3600, 1, T0 + NS)
+    assert r.remaining == 1  # 3 - 1 (pre-snapshot) - 1 (now)

@@ -35,7 +35,15 @@ def _compile(src: Path, out: Path, extra=()) -> Optional[str]:
     once let a stale committed binary silently shadow broken source.
     """
     out.parent.mkdir(parents=True, exist_ok=True)
-    digest = hashlib.sha256(src.read_bytes()).hexdigest()
+    # THROTTLECRAB_NATIVE_CFLAGS overrides the optimization/arch flags —
+    # container images should build for a portable baseline (e.g.
+    # -march=x86-64-v2) instead of the build machine's -march=native.
+    flags = os.environ.get(
+        "THROTTLECRAB_NATIVE_CFLAGS", "-O3 -march=native"
+    ).split()
+    digest = hashlib.sha256(
+        src.read_bytes() + " ".join(flags).encode()
+    ).hexdigest()
     stamp = out.with_suffix(out.suffix + ".sha256")
     if (
         not out.exists()
@@ -43,7 +51,7 @@ def _compile(src: Path, out: Path, extra=()) -> Optional[str]:
         or stamp.read_text().strip() != digest
     ):
         cmd = [
-            "g++", "-O3", "-march=native", "-std=c++17", "-shared",
+            "g++", *flags, "-std=c++17", "-shared",
             "-fPIC", str(src), "-o", str(out), *extra,
         ]
         try:

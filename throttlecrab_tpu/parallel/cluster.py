@@ -263,21 +263,42 @@ class ClusterLimiter(ScalarCompatMixin):
     @staticmethod
     def _key_bytes(k) -> bytes:
         # surrogateescape round-trips keys that native transports decoded
-        # from arbitrary bytes.
+        # from arbitrary bytes.  Raises UnicodeEncodeError for lone
+        # surrogates outside U+DC80-DCFF (JSON can deliver those) — the
+        # caller rejects such keys per-request.
         return (
             k.encode("utf-8", "surrogateescape")
             if isinstance(k, str)
             else bytes(k)
         )
 
-    def _partition(self, keys) -> List[np.ndarray]:
+    def _encode_and_partition(self, keys):
+        """Per-key wire bytes, per-key reject mask, and owner partition.
+
+        A key that cannot cross the wire (unencodable lone surrogate) or
+        exceeds the u16 length limit is rejected *individually* — it must
+        never fail its batchmates.
+        """
+        n = len(keys)
         n_nodes = len(self.nodes)
-        owners = np.fromiter(
-            (node_of_key(self._key_bytes(k), n_nodes) for k in keys),
-            np.int32,
-            count=len(keys),
-        )
-        return [np.flatnonzero(owners == d) for d in range(n_nodes)]
+        kb: List[bytes] = []
+        bad = np.zeros(n, bool)
+        owners = np.zeros(n, np.int32)
+        for i, k in enumerate(keys):
+            try:
+                b = self._key_bytes(k)
+            except UnicodeEncodeError:
+                kb.append(b"")
+                bad[i] = True
+                continue
+            if len(b) > MAX_KEY_BYTES:
+                bad[i] = True
+            kb.append(b)
+            owners[i] = node_of_key(b, n_nodes)
+        by_node = [
+            np.flatnonzero(~bad & (owners == d)) for d in range(n_nodes)
+        ]
+        return kb, bad, by_node
 
     @staticmethod
     def _broadcast(v, n):
@@ -288,33 +309,21 @@ class ClusterLimiter(ScalarCompatMixin):
         now_ns: int, wire: bool = False,
     ):
         n = len(keys)
-        by_node = self._partition(keys)
+        kb, bad, by_node = self._encode_and_partition(keys)
         mb = self._broadcast(max_burst, n)
         cp = self._broadcast(count_per_period, n)
         pd = self._broadcast(period, n)
         qt = self._broadcast(quantity, n)
-
-        # Cluster deployments cap keys at 64 KiB (u16 key_len on the
-        # wire); an oversized key fails only its own request, uniformly
-        # for local and remote owners.
-        oversized = np.zeros(n, bool)
-        for i, k in enumerate(keys):
-            if len(self._key_bytes(k)) > MAX_KEY_BYTES:
-                oversized[i] = True
 
         # Ship remote sub-batches first (pipelined), then decide locally
         # while peers work, then collect replies.
         sent: List[Tuple[int, np.ndarray]] = []
         failed_nodes: List[Tuple[int, np.ndarray]] = []
         for d, ix in enumerate(by_node):
-            if d == self.self_index:
+            if d == self.self_index or len(ix) == 0:
                 continue
-            ix = ix[~oversized[ix]]
-            if len(ix) == 0:
-                continue
-            bkeys = [self._key_bytes(keys[i]) for i in ix]
             frame = encode_batch(
-                bkeys,
+                [kb[i] for i in ix],
                 zip(mb[ix], cp[ix], pd[ix], qt[ix]),
                 now_ns,
             )
@@ -329,7 +338,6 @@ class ClusterLimiter(ScalarCompatMixin):
                 failed_nodes.append((d, ix))
 
         local_ix = by_node[self.self_index]
-        local_ix = local_ix[~oversized[local_ix]]
         local_res = None
         if len(local_ix):
             with self.device_lock:
@@ -402,9 +410,10 @@ class ClusterLimiter(ScalarCompatMixin):
         for _d, ix in failed_nodes:
             status[ix] = STATUS_INTERNAL
             allowed[ix] = False
-        if oversized.any():
-            status[oversized] = STATUS_INVALID_PARAMS
-            allowed[oversized] = False
+        if bad.any():
+            # Unencodable or over-length keys: each fails only itself.
+            status[bad] = STATUS_INVALID_PARAMS
+            allowed[bad] = False
 
         if wire:
             return WireBatchResult(
@@ -419,29 +428,33 @@ class ClusterLimiter(ScalarCompatMixin):
         )
 
     def rate_limit_many(self, batches, wire: bool = False) -> list:
-        """K batches: remote parts forward as K pipelined frames per peer
-        (one RPC round-trip), local parts take the local scan path."""
-        # Arrival order per key is preserved because a key always routes
-        # to the same node and frames are pipelined in order.
+        """K batches in arrival order.
+
+        Windows whose keys are ALL locally owned take the local scan path
+        (one launch for the whole window, under the device lock).  A
+        window containing any remote-owned key decides batch by batch —
+        each batch still forwards its remote sub-batches as whole frames,
+        but the window is a simple sequential composition (no cross-batch
+        frame pipelining).  Per-key arrival order holds either way
+        because a key always routes to the same node.
+        """
         if not batches:
             return []
-        if not hasattr(self.local, "rate_limit_many") or len(batches) == 1:
-            return [
-                self.rate_limit_batch(*b, wire=wire) for b in batches
-            ]
-        # Simple correct composition: per-batch partition/forward.  The
-        # local sub-batches still amortize through the local scan path.
-        parts = [self._partition(b[0]) for b in batches]
-        local_only = all(
-            all(
-                len(ix) == 0
-                for d, ix in enumerate(p)
-                if d != self.self_index
-            )
-            for p in parts
-        )
-        if local_only:
-            return self.local.rate_limit_many(batches, wire=wire)
+        can_scan = hasattr(self.local, "rate_limit_many")
+        if can_scan and len(batches) > 1:
+            local_only = True
+            for b in batches:
+                _, bad, by_node = self._encode_and_partition(b[0])
+                if bad.any() or any(
+                    len(ix)
+                    for d, ix in enumerate(by_node)
+                    if d != self.self_index
+                ):
+                    local_only = False
+                    break
+            if local_only:
+                with self.device_lock:
+                    return self.local.rate_limit_many(batches, wire=wire)
         return [self.rate_limit_batch(*b, wire=wire) for b in batches]
 
     # ------------------------------------------------------------------ #

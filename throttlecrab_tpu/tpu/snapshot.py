@@ -30,6 +30,8 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
     Works for TpuRateLimiter (single device).  Only live slots are saved:
     tat/expiry columns plus each slot's key bytes.
     """
+    from .limiter import limiter_uses_bytes_keys
+
     path = Path(path)
     tat = np.asarray(limiter.table.tat)
     expiry = np.asarray(limiter.table.expiry)
@@ -37,10 +39,25 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
     slots = []
     keys = []
     key_is_bytes = []
+    key_codec = []  # 0 = surrogateescape, 1 = surrogatepass
     for key, slot in limiter.keymap.items():
         slots.append(slot)
-        key_is_bytes.append(isinstance(key, (bytes, bytearray)))
-        keys.append(bytes(key) if key_is_bytes[-1] else str(key).encode())
+        is_b = isinstance(key, (bytes, bytearray))
+        key_is_bytes.append(is_b)
+        if is_b:
+            keys.append(bytes(key))
+            key_codec.append(0)
+        else:
+            # surrogateescape round-trips keys decoded from raw bytes;
+            # lone surrogates outside U+DC80-DCFF (JSON can deliver them)
+            # need surrogatepass — record which codec per key so restore
+            # reverses it exactly and one odd key can't lose a snapshot.
+            try:
+                keys.append(str(key).encode("utf-8", "surrogateescape"))
+                key_codec.append(0)
+            except UnicodeEncodeError:
+                keys.append(str(key).encode("utf-8", "surrogatepass"))
+                key_codec.append(1)
     slots = np.asarray(slots, np.int64)
 
     # Length-prefixed layout (offsets[n+1] + blob): binary-safe for keys
@@ -59,6 +76,11 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
         key_offsets=offsets,
         key_blob=np.frombuffer(key_blob, np.uint8),
         key_is_bytes=np.asarray(key_is_bytes, np.uint8),
+        key_codec=np.asarray(key_codec, np.uint8),
+        # The source keymap's key mode: a bytes-keyed (native) keymap
+        # stores every key as bytes even when the transports spoke str —
+        # the restore side needs this to translate identities correctly.
+        source_bytes_keys=np.uint8(limiter_uses_bytes_keys(limiter)),
         meta=np.frombuffer(
             json.dumps({"n_keys": len(keys)}).encode(), np.uint8
         ),
@@ -72,6 +94,8 @@ def load_snapshot(limiter, path: Union[str, Path], now_ns: int) -> int:
     `now_ns` gates restoration: entries already expired are skipped (the
     TTL contract holds across restarts).  The limiter must be empty.
     """
+    from .limiter import limiter_uses_bytes_keys
+
     if len(limiter) != 0:
         raise ValueError("restore requires an empty limiter")
     path = Path(path)
@@ -84,11 +108,29 @@ def load_snapshot(limiter, path: Union[str, Path], now_ns: int) -> int:
         offsets = data["key_offsets"]
         key_blob = data["key_blob"].tobytes()
         key_is_bytes = data["key_is_bytes"].astype(bool)
+        key_codec = (
+            data["key_codec"].astype(np.uint8)
+            if "key_codec" in data
+            else np.zeros(len(key_is_bytes), np.uint8)
+        )
+        source_bytes_keys = (
+            bool(data["source_bytes_keys"])
+            if "source_bytes_keys" in data
+            else False
+        )
         meta = json.loads(data["meta"].tobytes())
 
     n = len(offsets) - 1
     if meta["n_keys"] != n or len(tat) != n or len(expiry) != n:
         raise ValueError("corrupt snapshot: array lengths disagree")
+
+    # Cross-backend identity translation: str-keyed transports look keys
+    # up as str, bytes-keyed (native) keymaps as bytes.  A snapshot from a
+    # native keymap marks everything bytes even though the transports used
+    # str — restoring it into a python keymap must decode back to str
+    # (surrogateescape: lossless for arbitrary bytes) or the restored
+    # buckets would be silently unreachable.
+    target_bytes_keys = limiter_uses_bytes_keys(limiter)
     live = expiry > now_ns
     restored = 0
     batch_keys = []
@@ -98,7 +140,16 @@ def load_snapshot(limiter, path: Union[str, Path], now_ns: int) -> int:
         if not live[i]:
             continue
         raw = key_blob[offsets[i] : offsets[i + 1]]
-        batch_keys.append(raw if key_is_bytes[i] else raw.decode())
+        codec = "surrogatepass" if key_codec[i] else "surrogateescape"
+        if target_bytes_keys:
+            key = raw  # native keymaps hold bytes; str lookups encode
+        elif source_bytes_keys and key_is_bytes[i]:
+            key = raw.decode("utf-8", "surrogateescape")
+        elif key_is_bytes[i]:
+            key = raw  # genuinely-bytes key in a str keymap: keep as-is
+        else:
+            key = raw.decode("utf-8", codec)
+        batch_keys.append(key)
         batch_tat.append(int(tat[i]))
         batch_exp.append(int(expiry[i]))
         restored += 1
@@ -115,7 +166,12 @@ def _bulk_insert(limiter, keys, tats, expiries) -> None:
     from .kernel import pack_state
 
     if getattr(limiter.keymap, "BYTES_KEYS", False):
-        key_src = [k if isinstance(k, bytes) else k.encode() for k in keys]
+        key_src = [
+            k
+            if isinstance(k, bytes)
+            else k.encode("utf-8", "surrogateescape")
+            for k in keys
+        ]
     else:
         key_src = keys  # original identity preserved (str stays str)
     valid = np.ones(len(keys), bool)
