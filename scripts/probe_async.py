@@ -1,0 +1,140 @@
+"""Probe the async-dispatch behavior of the accelerator tunnel.
+
+Answers three questions the launch profiler raised:
+  a) does jax.device_put return before the transfer completes?
+  b) do back-to-back launch dispatches queue asynchronously (N launches,
+     one block == latency + N * device_time) or serialize (N * latency)?
+  c) how does scan depth K scale device time?
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import throttlecrab_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from throttlecrab_tpu.tpu.kernel import gcra_scan
+from throttlecrab_tpu.tpu.table import BucketTable
+
+dev = jax.devices()[0]
+print(f"device: {dev}", file=sys.stderr)
+
+B, CAP = 4096, 1 << 21
+rng = np.random.default_rng(3)
+
+
+def payload(K):
+    return (
+        rng.integers(0, CAP - 1, (K, B)).astype(np.int32),
+        np.zeros((K, B), np.int32),
+        np.ones((K, B), bool),
+        np.full((K, B), 20_000_000, np.int64),
+        np.full((K, B), 1_000_000_000, np.int64),
+        np.ones((K, B), np.int64),
+        np.ones((K, B), bool),
+        np.full(K, 1_753_000_000_000_000_000, np.int64),
+    )
+
+
+# ---- a) device_put async? -----------------------------------------------
+big = np.ones(2_000_000, np.int32)
+jax.device_put(big, dev).block_until_ready()
+t0 = time.perf_counter()
+x = jax.device_put(big, dev)
+t_ret = time.perf_counter() - t0
+x.block_until_ready()
+t_done = time.perf_counter() - t0
+print(f"a) device_put 8MB: returns in {t_ret*1e3:.2f} ms, done in {t_done*1e3:.2f} ms")
+
+# ---- b) async dispatch depth --------------------------------------------
+table = BucketTable(CAP)
+pay = payload(16)
+dev_pay = [jax.device_put(a, dev) for a in pay]
+jax.block_until_ready(dev_pay)
+
+# warm compile
+table.state, out = gcra_scan(table.state, *dev_pay, with_degen=False, compact=True)
+out.block_until_ready()
+
+for n in (1, 2, 4, 8):
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(n):
+        table.state, out = gcra_scan(
+            table.state, *dev_pay, with_degen=False, compact=True
+        )
+        outs.append(out)
+    t_disp = time.perf_counter() - t0
+    np.asarray(outs[-1])
+    t_all = time.perf_counter() - t0
+    print(
+        f"b) {n} launches (device-resident inputs): dispatch {t_disp*1e3:7.2f} ms, "
+        f"total {t_all*1e3:7.2f} ms  ({t_all/n*1e3:6.2f} ms/launch)"
+    )
+
+# same but with fresh host->device transfer per launch (the serving shape)
+for n in (1, 4, 8):
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(n):
+        arrs = [jax.device_put(a, dev) for a in pay]
+        table.state, out = gcra_scan(
+            table.state, *arrs, with_degen=False, compact=True
+        )
+        outs.append(out)
+    t_disp = time.perf_counter() - t0
+    np.asarray(outs[-1])
+    t_all = time.perf_counter() - t0
+    print(
+        f"b2) {n} launches (h2d per launch):       dispatch {t_disp*1e3:7.2f} ms, "
+        f"total {t_all*1e3:7.2f} ms  ({t_all/n*1e3:6.2f} ms/launch)"
+    )
+
+# same but passing raw numpy straight into the jitted call
+for n in (1, 4, 8):
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(n):
+        table.state, out = gcra_scan(
+            table.state, *pay, with_degen=False, compact=True
+        )
+        outs.append(out)
+    t_disp = time.perf_counter() - t0
+    np.asarray(outs[-1])
+    t_all = time.perf_counter() - t0
+    print(
+        f"b3) {n} launches (numpy args direct):    dispatch {t_disp*1e3:7.2f} ms, "
+        f"total {t_all*1e3:7.2f} ms  ({t_all/n*1e3:6.2f} ms/launch)"
+    )
+
+# ---- c) scan depth scaling ----------------------------------------------
+for K in (16, 64, 128):
+    payK = payload(K)
+    devK = [jax.device_put(a, dev) for a in payK]
+    jax.block_until_ready(devK)
+    table.state, out = gcra_scan(
+        table.state, *devK, with_degen=False, compact=True
+    )
+    out.block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(3):
+        table.state, out = gcra_scan(
+            table.state, *devK, with_degen=False, compact=True
+        )
+        out.block_until_ready()
+    dt = (time.perf_counter() - t0) / 3
+    print(
+        f"c) scan K={K:4d}: {dt*1e3:7.2f} ms/launch blocked "
+        f"({K*B/dt/1e6:6.2f} M dec/s)"
+    )

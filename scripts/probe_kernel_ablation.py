@@ -1,0 +1,125 @@
+"""Ablate the scan kernel to find the 270ms/launch cost on the tunnel TPU.
+
+Axes: table capacity, scan depth K, and kernel body (full / no-scatter /
+no-gather / elementwise-only).  All timings force a real output fetch —
+block_until_ready is not trustworthy on this platform.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import throttlecrab_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from throttlecrab_tpu.tpu.kernel import (
+    EMPTY_EXPIRY,
+    pack_state,
+    unpack_state,
+    sat_add,
+    sat_sub,
+)
+from throttlecrab_tpu.tpu.sat import sat_mul_nonneg, div_trunc
+
+dev = jax.devices()[0]
+print(f"device: {dev}", file=sys.stderr, flush=True)
+
+B = 4096
+NOW = 1_753_000_000_000_000_000
+
+
+def make_state(cap):
+    return pack_state(
+        jnp.zeros((cap,), jnp.int64),
+        jnp.full((cap,), EMPTY_EXPIRY, jnp.int64),
+    )
+
+
+def body(state, batch, mode):
+    slots, emission, tolerance, now = batch
+    N = state.shape[0]
+    s = jnp.clip(slots, 0, N - 1).astype(jnp.int32)
+    if mode in ("full", "noscatter"):
+        stored_tat, stored_exp = unpack_state(state[s])
+    else:  # nogather / elementwise
+        stored_tat = slots.astype(jnp.int64) * 1_000
+        stored_exp = jnp.full_like(stored_tat, EMPTY_EXPIRY)
+    live = stored_exp > now
+    inc = emission
+    t0 = jnp.where(
+        live, jnp.maximum(stored_tat, sat_sub(now, tolerance)),
+        sat_sub(now, emission),
+    )
+    num = sat_sub(sat_add(now, tolerance), t0)
+    m_raw = jnp.maximum(div_trunc(num, inc), 0)
+    allowed = m_raw >= 1
+    tat_fin = sat_add(t0, inc)
+    expiry_fin = sat_add(tat_fin, tolerance)
+    if mode in ("full", "nogather"):
+        rows = pack_state(tat_fin, expiry_fin)
+        state = state.at[s].set(rows, mode="drop")
+    out = allowed.astype(jnp.int32)
+    return state, out
+
+
+def make_scan(mode):
+    @partial(jax.jit, donate_argnums=(0,))
+    def scan(state, slots, emission, tolerance, now):
+        def step(st, kb):
+            return body(st, kb, mode)
+
+        return jax.lax.scan(
+            step, state, (slots, emission, tolerance, now.astype(jnp.int64))
+        )
+
+    return scan
+
+
+def run(cap, K, mode, n=4):
+    rng = np.random.default_rng(3)
+    state = make_state(cap)
+    slots = jax.device_put(
+        rng.integers(0, cap - 1, (K, B)).astype(np.int32), dev
+    )
+    em = jax.device_put(np.full((K, B), 20_000_000, np.int64), dev)
+    tol = jax.device_put(np.full((K, B), 1_000_000_000, np.int64), dev)
+    now = jax.device_put(np.full(K, NOW, np.int64), dev)
+    scan = make_scan(mode)
+    state, out = scan(state, slots, em, tol, now)
+    np.asarray(out)  # compile + drain
+    state, out = scan(state, slots, em, tol, now)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, out = scan(state, slots, em, tol, now)
+        np.asarray(out)
+    dt = (time.perf_counter() - t0) / n
+    print(
+        f"cap=2^{cap.bit_length()-1:2d} K={K:4d} {mode:11s}: "
+        f"{dt*1e3:8.2f} ms/launch  ({K*B/dt/1e6:7.2f} M dec/s)", flush=True
+    )
+    return dt
+
+
+print("--- kernel body ablation (cap=2^21, K=64) ---", flush=True)
+for mode in ("full", "noscatter", "nogather", "elementwise"):
+    run(1 << 21, 64, mode)
+
+print("--- table size (full, K=64) ---", flush=True)
+for cap in (1 << 16, 1 << 18, 1 << 21):
+    run(cap, 64, "full")
+
+print("--- scan depth (full, cap=2^21) ---", flush=True)
+for K in (16, 64, 256):
+    run(1 << 21, K, "full")

@@ -1,0 +1,206 @@
+"""Decompose the per-launch cost of the TPU decision path.
+
+Round-3 measurement (BENCH_r03.json) put one 16x4096-decision launch at
+167 ms p50 on real TPU v5e vs 39 ms on CPU for the identical host path.
+This script isolates where that time goes, on whatever backend it runs on:
+
+  1. tunnel ping        — trivial scalar op, dispatch + fetch round trip
+  2. h2d transfer       — the per-launch input payload, timed alone
+  3. device compute     — gcra_scan with device-resident inputs, block only
+  4. d2h fetch          — np.asarray of the [K, 4, B] compact output
+  5. end-to-end         — the bench.py run_launch path for comparison
+
+Usage:  python scripts/profile_launch.py [--cpu] [--trace DIR]
+
+With --trace DIR an xprof trace of the steady-state launches is captured
+via throttlecrab_tpu.tpu.profiling.trace for TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, warm=3, iters=10):
+    for _ in range(warm):
+        fn()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2], ts[-1]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--trace", default=None)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--depth", type=int, default=16)
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import throttlecrab_tpu  # noqa: F401  (enables x64)
+    import jax
+    import jax.numpy as jnp
+
+    from throttlecrab_tpu.tpu.kernel import gcra_scan
+    from throttlecrab_tpu.tpu.table import BucketTable
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})", file=sys.stderr)
+
+    B, K = args.batch, args.depth
+    CAP = 1 << 21
+    rng = np.random.default_rng(3)
+    report = {"device": str(dev), "platform": dev.platform, "B": B, "K": K}
+
+    # ---- 1. tunnel ping --------------------------------------------------
+    one = jnp.ones((), jnp.int32)
+    add = jax.jit(lambda x: x + 1)
+    add(one).block_until_ready()
+    p50, p99 = timeit(lambda: np.asarray(add(one)))
+    report["ping_ms"] = round(p50 * 1e3, 3)
+    print(f"1. ping (scalar op + fetch):      {p50 * 1e3:8.2f} ms", file=sys.stderr)
+
+    # dispatch-only (no fetch): how much of ping is the blocking fetch
+    p50, _ = timeit(lambda: add(one).block_until_ready())
+    report["ping_noblockfetch_ms"] = round(p50 * 1e3, 3)
+    print(f"   ping (block, no np.asarray):   {p50 * 1e3:8.2f} ms", file=sys.stderr)
+
+    # ---- input payload ---------------------------------------------------
+    slots = rng.integers(0, CAP - 1, (K, B)).astype(np.int32)
+    rank = np.zeros((K, B), np.int32)
+    is_last = np.ones((K, B), bool)
+    emission = np.full((K, B), 20_000_000, np.int64)
+    tol = np.full((K, B), 1_000_000_000, np.int64)
+    qty = np.ones((K, B), np.int64)
+    valid = np.ones((K, B), bool)
+    now = np.full(K, 1_753_000_000_000_000_000, np.int64)
+    payload = (slots, rank, is_last, emission, tol, qty, valid, now)
+    nbytes = sum(a.nbytes for a in payload)
+    report["h2d_bytes"] = nbytes
+
+    # ---- 2. h2d transfer -------------------------------------------------
+    def h2d():
+        arrs = [jax.device_put(a, dev) for a in payload]
+        jax.block_until_ready(arrs)
+        return arrs
+
+    p50, p99 = timeit(h2d)
+    report["h2d_ms"] = round(p50 * 1e3, 3)
+    print(
+        f"2. h2d transfer ({nbytes / 1e6:.1f} MB, 8 arrays): {p50 * 1e3:8.2f} ms",
+        file=sys.stderr,
+    )
+
+    # single fused buffer for comparison
+    fused = np.concatenate([a.view(np.uint8).ravel() for a in payload])
+
+    def h2d_fused():
+        jax.device_put(fused, dev).block_until_ready()
+
+    p50, _ = timeit(h2d_fused)
+    report["h2d_fused_ms"] = round(p50 * 1e3, 3)
+    print(f"   h2d one fused buffer:          {p50 * 1e3:8.2f} ms", file=sys.stderr)
+
+    # ---- 3. device compute (inputs resident, output blocked not fetched) --
+    table = BucketTable(CAP)
+    dev_payload = h2d()
+
+    def compute():
+        nonlocal table
+        table.state, out = gcra_scan(
+            table.state, *dev_payload, with_degen=False, compact=True
+        )
+        out.block_until_ready()
+        return out
+
+    p50, p99 = timeit(compute)
+    report["compute_ms"] = round(p50 * 1e3, 3)
+    report["compute_p99_ms"] = round(p99 * 1e3, 3)
+    print(f"3. device compute (scan x{K}):     {p50 * 1e3:8.2f} ms", file=sys.stderr)
+
+    # ---- 4. d2h fetch ----------------------------------------------------
+    out = compute()
+    report["d2h_bytes"] = out.size * 4
+
+    p50, _ = timeit(lambda: np.asarray(out))
+    report["d2h_ms"] = round(p50 * 1e3, 3)
+    print(
+        f"4. d2h fetch ({out.size * 4 / 1e6:.1f} MB compact out): {p50 * 1e3:8.2f} ms",
+        file=sys.stderr,
+    )
+
+    # ---- 5. end-to-end: h2d + compute + fetch ----------------------------
+    def end_to_end():
+        nonlocal table
+        arrs = [jax.device_put(a, dev) for a in payload]
+        table.state, out = gcra_scan(
+            table.state, *arrs, with_degen=False, compact=True
+        )
+        return np.asarray(out)
+
+    p50, p99 = timeit(end_to_end)
+    report["e2e_ms"] = round(p50 * 1e3, 3)
+    report["e2e_p99_ms"] = round(p99 * 1e3, 3)
+    rate = K * B / p50
+    report["e2e_decisions_per_s"] = round(rate)
+    print(
+        f"5. end-to-end launch:             {p50 * 1e3:8.2f} ms "
+        f"({rate / 1e6:.2f} M decisions/s)",
+        file=sys.stderr,
+    )
+
+    # ---- 5b. pipelined: dispatch N+1 before fetching N's output ----------
+    def pipelined(n_launch=8):
+        nonlocal table
+        pending = None
+        t0 = time.perf_counter()
+        for _ in range(n_launch):
+            arrs = [jax.device_put(a, dev) for a in payload]
+            table.state, out = gcra_scan(
+                table.state, *arrs, with_degen=False, compact=True
+            )
+            if pending is not None:
+                np.asarray(pending)
+            pending = out
+        np.asarray(pending)
+        return (time.perf_counter() - t0) / n_launch
+
+    pipelined(2)
+    per = min(pipelined() for _ in range(3))
+    report["pipelined_ms"] = round(per * 1e3, 3)
+    report["pipelined_decisions_per_s"] = round(K * B / per)
+    print(
+        f"5b. pipelined launch:             {per * 1e3:8.2f} ms "
+        f"({K * B / per / 1e6:.2f} M decisions/s)",
+        file=sys.stderr,
+    )
+
+    if args.trace:
+        from throttlecrab_tpu.tpu.profiling import trace
+
+        with trace(args.trace):
+            for _ in range(4):
+                end_to_end()
+        print(f"xprof trace written to {args.trace}", file=sys.stderr)
+        report["trace_dir"] = args.trace
+
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

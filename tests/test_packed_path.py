@@ -1,0 +1,212 @@
+"""Packed-launch path: one fused i32 buffer must decide identically to the
+eight-array scan path, and the C++ assembler must emit exactly what the
+Python resolve + numpy packing emits."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from throttlecrab_tpu.tpu.kernel import (
+    EMPTY_EXPIRY,
+    PACK_WIDTH,
+    gcra_scan,
+    gcra_scan_packed,
+    pack_requests,
+    pack_state,
+    unpack_state,
+)
+
+NS = 1_000_000_000
+BASE = 1_753_700_000 * NS
+N = 1024  # table rows incl. scratch tail
+K, B = 4, 64
+
+
+def make_table():
+    return pack_state(
+        jnp.zeros((N,), jnp.int64),
+        jnp.full((N,), EMPTY_EXPIRY, jnp.int64),
+    )
+
+
+def segment_info(slots_2d, valid_2d):
+    rank = np.zeros_like(slots_2d, np.int32)
+    is_last = np.ones(slots_2d.shape, bool)
+    for k in range(slots_2d.shape[0]):
+        seen: dict = {}
+        for i in range(slots_2d.shape[1]):
+            if not valid_2d[k, i]:
+                continue
+            s = int(slots_2d[k, i])
+            if s in seen:
+                rank[k, i] = seen[s][0]
+                seen[s][0] += 1
+                is_last[k, seen[s][1]] = False
+                seen[s][1] = i
+            else:
+                seen[s] = [1, i]
+    return rank, is_last
+
+
+def random_launch(rng, degen=False):
+    slots = rng.integers(0, 48, (K, B)).astype(np.int32)
+    valid = rng.random((K, B)) > 0.1
+    rank, is_last = segment_info(slots, valid)
+    em = np.full((K, B), 600_000_000, np.int64)
+    tol = em * rng.integers(0 if degen else 1, 9, (K, B))
+    q = rng.integers(0 if degen else 1, 3, (K, B)).astype(np.int64)
+    # Uniform params per slot within each micro-batch (engine invariant).
+    for k in range(K):
+        first: dict = {}
+        for i in range(B):
+            s = int(slots[k, i])
+            if s in first:
+                tol[k, i] = tol[k, first[s]]
+                q[k, i] = q[k, first[s]]
+            else:
+                first[s] = i
+    now = BASE + np.arange(K, dtype=np.int64) * 50_000_000
+    return slots, rank, is_last, em, tol, q, valid, now
+
+
+@pytest.mark.parametrize("degen", [False, True])
+@pytest.mark.parametrize("compact", [False, True])
+def test_packed_scan_matches_unpacked(degen, compact):
+    rng = np.random.default_rng(11)
+    slots, rank, is_last, em, tol, q, valid, now = random_launch(rng, degen)
+
+    st_a, out_a = gcra_scan(
+        make_table(),
+        jnp.asarray(slots), jnp.asarray(rank), jnp.asarray(is_last),
+        jnp.asarray(em), jnp.asarray(tol), jnp.asarray(q),
+        jnp.asarray(valid), jnp.asarray(now),
+        with_degen=True, compact=compact,
+    )
+
+    packed = pack_requests(slots, rank, is_last, em, tol, q, valid)
+    assert packed.shape == (K, B, PACK_WIDTH)
+    st_b, out_b = gcra_scan_packed(
+        make_table(), jnp.asarray(packed), jnp.asarray(now),
+        with_degen=True, compact=compact,
+    )
+
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+    tat_a, exp_a = (np.asarray(x) for x in unpack_state(st_a))
+    tat_b, exp_b = (np.asarray(x) for x in unpack_state(st_b))
+    np.testing.assert_array_equal(tat_a, tat_b)
+    np.testing.assert_array_equal(exp_a, exp_b)
+
+
+def test_pack_requests_roundtrips_i64_extremes():
+    I64_MAX = (1 << 63) - 1
+    vals = np.array([0, 1, -1, I64_MAX, -I64_MAX - 1, 1 << 33], np.int64)
+    n = len(vals)
+    packed = pack_requests(
+        np.zeros(n, np.int32), np.zeros(n, np.int32), np.ones(n, bool),
+        vals, vals, vals, np.ones(n, bool),
+    )
+    lo = packed[:, 3].view(np.uint32).astype(np.int64)
+    hi = packed[:, 4].astype(np.int64)
+    np.testing.assert_array_equal((hi << 32) | lo, vals)
+
+
+# ---------------------------------------------------------------------- #
+# C++ assembler vs Python resolve + numpy packing.
+
+from throttlecrab_tpu.native import (  # noqa: E402
+    keymap_build_error,
+    native_available,
+    toolchain_available,
+)
+
+needs_native = pytest.mark.skipif(
+    not toolchain_available(), reason="no C++ toolchain in environment"
+)
+
+
+@needs_native
+def test_native_assemble_matches_resolve():
+    assert native_available(), keymap_build_error()
+    from throttlecrab_tpu.native import NativeKeyMap
+
+    keys = [b"key:%d" % i for i in range(200)]
+    em_by_id = (np.arange(200, dtype=np.int64) + 1) * 1_000_000
+    tol_by_id = em_by_id * 4
+
+    km_a = NativeKeyMap(512)
+    first = km_a.intern(keys)
+    assert first == 0
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 200, K * B).astype(np.int32)
+    packed, n_full = km_a.assemble(ids, B, em_by_id, tol_by_id, quantity=2)
+    assert n_full == 0
+    assert packed.shape == (K * B, PACK_WIDTH)
+
+    # Reference: per-micro-batch resolve through a fresh keymap + numpy pack.
+    km_b = NativeKeyMap(512)
+    for k in range(K):
+        sel = ids[k * B : (k + 1) * B]
+        batch_keys = [keys[i] for i in sel]
+        slots, rank, is_last, nf = km_b.resolve(
+            batch_keys, np.ones(B, bool)
+        )
+        assert nf == 0
+        expect = pack_requests(
+            slots, rank, is_last,
+            em_by_id[sel], tol_by_id[sel],
+            np.full(B, 2, np.int64), np.ones(B, bool),
+        )
+        np.testing.assert_array_equal(
+            packed[k * B : (k + 1) * B], expect,
+            err_msg=f"micro-batch {k}",
+        )
+
+
+@needs_native
+def test_native_assemble_padding_and_full():
+    from throttlecrab_tpu.native import NativeKeyMap
+
+    km = NativeKeyMap(4)  # only 4 slots
+    km.intern([b"a", b"b", b"c", b"d", b"e", b"f"])
+    em = np.full(6, 1_000_000, np.int64)
+    ids = np.array([0, 1, 2, 3, 4, 5, -1, 0], np.int32)
+    packed, n_full = km.assemble(ids, len(ids), em, em, quantity=1)
+    assert n_full == 2  # e, f dropped: table full
+    valid = (packed[:, 2] & 2) != 0
+    np.testing.assert_array_equal(
+        valid, [True, True, True, True, False, False, False, True]
+    )
+    assert packed[4, 0] == -1 and packed[6, 0] == -1
+    # id 0 re-used after padding: same slot as its first occurrence,
+    # rank 1, and the first occurrence lost its is_last flag.
+    assert packed[7, 0] == packed[0, 0]
+    assert packed[7, 1] == 1
+    assert (packed[0, 2] & 1) == 0 and (packed[7, 2] & 1) == 1
+    # Un-interned (out-of-range) ids are counted as failures, not padding.
+    packed2, n_full2 = km.assemble(
+        np.array([0, 99], np.int32), 2, em, em, quantity=1
+    )
+    assert n_full2 == 1 and packed2[1, 0] == -1 and packed2[1, 2] == 0
+
+
+@needs_native
+def test_native_assemble_multiple_intern_calls():
+    from throttlecrab_tpu.native import NativeKeyMap
+
+    km = NativeKeyMap(64)
+    assert km.intern([b"x", b"y"]) == 0
+    assert km.intern([b"z"]) == 2
+    em = np.array([10, 20, 30], np.int64) * 1_000_000
+    packed, n_full = km.assemble(
+        np.array([2, 0, 1], np.int32), 3, em, em * 2
+    )
+    assert n_full == 0
+    # Params follow the id, not the slot.
+    lo = packed[:, 3].view(np.uint32).astype(np.int64)
+    hi = packed[:, 4].astype(np.int64)
+    np.testing.assert_array_equal((hi << 32) | lo, em[[2, 0, 1]])
+    # Same keys through resolve agree on slots.
+    slots, _, _, _ = km.resolve([b"z", b"x", b"y"], np.ones(3, bool))
+    np.testing.assert_array_equal(packed[:, 0], slots)

@@ -88,6 +88,15 @@ def _build() -> Optional[ctypes.CDLL]:
     ]
     lib.tk_free_slots.restype = ctypes.c_int64
     lib.tk_free_slots.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.tk_intern_keys.restype = ctypes.c_int64
+    lib.tk_intern_keys.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.tk_assemble.restype = ctypes.c_int64
+    lib.tk_assemble.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
     lib.tk_export_sizes.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
     ]
@@ -229,6 +238,61 @@ class NativeKeyMap:
             is_last.ctypes.data_as(ctypes.c_void_p),
         )
         return slots, rank, is_last.astype(bool), int(n_full)
+
+    def intern(self, keys: Sequence[bytes]) -> int:
+        """Register keys for id-based assembly; returns the first new id
+        (ids are sequential in call order across intern calls)."""
+        n = len(keys)
+        buf = b"".join(keys)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+        return int(
+            self._lib.tk_intern_keys(
+                self._h, buf, offsets.ctypes.data_as(ctypes.c_void_p), n
+            )
+        )
+
+    def assemble(
+        self,
+        ids: np.ndarray,
+        batch: int,
+        em_by_id: np.ndarray,
+        tol_by_id: np.ndarray,
+        quantity: int = 1,
+        out: Optional[np.ndarray] = None,
+    ):
+        """Build a packed launch buffer (kernel.PACK_WIDTH layout) straight
+        from interned key ids: one C++ call assembles the whole K×B launch,
+        re-hashing each key through the table (allocating slots on miss) and
+        emitting the duplicate-segment structure per `batch`-sized
+        micro-batch.  Returns (packed i32[total, PACK_WIDTH], n_full)."""
+        from .tpu.kernel import PACK_WIDTH
+
+        ids = np.ascontiguousarray(ids, np.int32)
+        total = len(ids)
+        if out is None:
+            out = np.empty((total, PACK_WIDTH), np.int32)
+        elif (
+            out.shape != (total, PACK_WIDTH)
+            or out.dtype != np.int32
+            or not out.flags.c_contiguous
+        ):
+            raise ValueError(
+                "out must be a C-contiguous i32[total, PACK_WIDTH] buffer"
+            )
+        em_by_id = np.ascontiguousarray(em_by_id, np.int64)
+        tol_by_id = np.ascontiguousarray(tol_by_id, np.int64)
+        n_full = self._lib.tk_assemble(
+            self._h,
+            ids.ctypes.data_as(ctypes.c_void_p),
+            total,
+            batch,
+            em_by_id.ctypes.data_as(ctypes.c_void_p),
+            tol_by_id.ctypes.data_as(ctypes.c_void_p),
+            quantity,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out, int(n_full)
 
     def free_slots(self, slot_indices: np.ndarray) -> int:
         arr = np.ascontiguousarray(slot_indices, np.int32)

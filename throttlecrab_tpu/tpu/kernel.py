@@ -73,6 +73,17 @@ EMPTY_EXPIRY = -(1 << 63)  # expiry sentinel: always in the past
 
 _U32 = (1 << 32) - 1
 
+# Packed request row: one i32[PACK_WIDTH] word group per request, so a whole
+# launch travels host→device as ONE buffer instead of eight arrays.  The
+# serving tunnel charges a fixed ~6 ms per transfer *call* (measured round 4,
+# docs/tpu-launch-profile.md), so eight device_puts per launch cost ~46 ms of
+# pure per-call latency — one packed buffer pays it once.
+#   w0 slot | w1 rank | w2 flags(bit0 is_last, bit1 valid)
+#   w3/w4 emission lo/hi | w5/w6 tolerance lo/hi | w7/w8 quantity lo/hi
+PACK_WIDTH = 9
+PACK_FLAG_IS_LAST = 1
+PACK_FLAG_VALID = 2
+
 
 def pack_state(tat, expiry):
     """(i64[N], i64[N]) → i32[N, 4] rows [tat_lo, tat_hi, exp_lo, exp_hi].
@@ -99,6 +110,47 @@ def unpack_state(state):
     return (
         join(state[..., 0], state[..., 1]),
         join(state[..., 2], state[..., 3]),
+    )
+
+
+def pack_requests(slots, rank, is_last, emission, tolerance, quantity, valid):
+    """Host-side packing: [...]-shaped request arrays → i32[..., PACK_WIDTH].
+
+    numpy fallback for the C++ assembler (native/keymap.cpp tk_assemble),
+    which writes the same layout straight from key ids with no intermediate
+    arrays.
+    """
+    import numpy as np
+
+    out = np.empty(np.shape(slots) + (PACK_WIDTH,), np.int32)
+    out[..., 0] = slots
+    out[..., 1] = rank
+    out[..., 2] = np.asarray(is_last, np.int32) * PACK_FLAG_IS_LAST + (
+        np.asarray(valid, np.int32) * PACK_FLAG_VALID
+    )
+    for base, arr in ((3, emission), (5, tolerance), (7, quantity)):
+        a = np.asarray(arr, np.int64)
+        out[..., base] = (a & _U32).astype(np.uint32).view(np.int32)
+        out[..., base + 1] = (a >> 32).astype(np.int32)
+    return out
+
+
+def _unpack_requests(packed, now):
+    """i32[B, PACK_WIDTH] → the _gcra_body batch tuple (device side)."""
+
+    def join(lo, hi):
+        return (hi.astype(jnp.int64) << 32) | (lo.astype(jnp.int64) & _U32)
+
+    flags = packed[..., 2]
+    return (
+        packed[..., 0],                                   # slots
+        packed[..., 1].astype(jnp.int64),                 # rank
+        (flags & PACK_FLAG_IS_LAST) != 0,                 # is_last
+        join(packed[..., 3], packed[..., 4]),             # emission
+        join(packed[..., 5], packed[..., 6]),             # tolerance
+        join(packed[..., 7], packed[..., 8]),             # quantity
+        (flags & PACK_FLAG_VALID) != 0,                   # valid
+        now,
     )
 
 
@@ -404,6 +456,36 @@ def gcra_scan(
         ),
     )
     return state, outs
+
+
+@partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("with_degen", "compact")
+)
+def gcra_scan_packed(state, packed, now, *, with_degen=True, compact=False):
+    """gcra_scan with the whole launch in ONE packed buffer.
+
+    Args:
+      state:  i32[N, 4] packed table rows (donated).
+      packed: i32[K, B, PACK_WIDTH] request rows (see pack_requests).
+      now:    i64[K] per-sub-batch server timestamps.
+
+    Semantically identical to gcra_scan on the unpacked arrays; the packed
+    form exists because the serving tunnel's fixed per-transfer cost
+    dominates the launch budget (docs/tpu-launch-profile.md) — one
+    host→device buffer per launch instead of eight.
+    Returns (state, out[K, 4, B]).
+    """
+
+    def step(state, kb):
+        packed_k, now_k = kb
+        return _gcra_body(
+            state,
+            _unpack_requests(packed_k, now_k),
+            with_degen=with_degen,
+            compact=compact,
+        )
+
+    return jax.lax.scan(step, state, (packed, now.astype(jnp.int64)))
 
 
 @partial(jax.jit, donate_argnums=(1,), static_argnames=("capacity",))

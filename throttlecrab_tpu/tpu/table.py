@@ -24,6 +24,7 @@ from .kernel import (
     EMPTY_EXPIRY,
     gcra_batch,
     gcra_scan,
+    gcra_scan_packed,
     pack_state,
     sweep_expired,
     unpack_state,
@@ -121,6 +122,34 @@ class BucketTable:
             jnp.asarray(tolerance, jnp.int64),
             jnp.asarray(quantity, jnp.int64),
             jnp.asarray(valid, bool),
+            jnp.asarray(now_ns, jnp.int64),
+            with_degen=with_degen,
+            compact=compact,
+        )
+        return out
+
+    def check_many_packed(
+        self,
+        packed,
+        now_ns,
+        with_degen: bool = True,
+        compact: bool = False,
+    ) -> jax.Array:
+        """K stacked micro-batches from ONE packed i32[K, B, PACK_WIDTH]
+        buffer (see kernel.pack_requests); `now_ns` is i64[K].
+
+        Unlike check_many this does NOT convert the output — it returns the
+        device array untouched so a pipelined caller can defer the fetch
+        (dispatch launch N+1 before reading launch N's results; the tunnel's
+        dispatch path is fully asynchronous).  `packed` may be a numpy array
+        or an already-transferred device array.
+        """
+        assert packed.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
+        self.state, out = gcra_scan_packed(
+            self.state,
+            packed
+            if isinstance(packed, jax.Array)
+            else jnp.asarray(packed, jnp.int32),
             jnp.asarray(now_ns, jnp.int64),
             with_degen=with_degen,
             compact=compact,
