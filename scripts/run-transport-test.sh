@@ -5,12 +5,24 @@
 # shuts the server down.
 #
 # Usage: scripts/run-transport-test.sh [-t http|grpc|redis|all] [-T workers]
-#        [-r requests-per-worker] [--cpu]
+#        [-r requests-per-worker] [--cpu] [--native] [--pipeline N]
+#        [--procs N] [--warm N]
+#
+#   --native      use the C++ epoll backends for HTTP and RESP
+#   --pipeline N  RESP only: N commands per pipelined write
+#   --procs N     client worker processes (single-proc Python tops out
+#                 around ~50K pipelined resp/s)
+#   --warm N      per-transport warmup requests before the timed run
+#                 (first-touch jit compiles take 10-40s on CPU)
 set -euo pipefail
 
 TRANSPORT=all
 WORKERS=32
 REQUESTS=1000
+PIPELINE=1
+PROCS=1
+WARM=64
+BACKEND=python
 HTTP_PORT=58080
 GRPC_PORT=58070
 REDIS_PORT=58060
@@ -22,6 +34,10 @@ while [[ $# -gt 0 ]]; do
     -T) WORKERS="$2"; shift 2 ;;
     -r) REQUESTS="$2"; shift 2 ;;
     --cpu) EXTRA_ENV+=("THROTTLECRAB_BENCH_CPU=1"); shift ;;
+    --native) BACKEND=native; shift ;;
+    --pipeline) PIPELINE="$2"; shift 2 ;;
+    --procs) PROCS="$2"; shift 2 ;;
+    --warm) WARM="$2"; shift 2 ;;
     *) echo "unknown arg: $1" >&2; exit 2 ;;
   esac
 done
@@ -38,9 +54,9 @@ sys.exit(main(sys.argv[1:]))
 '
 
 env "${EXTRA_ENV[@]}" python -c "$PYBOOT" \
-    --http --http-port "$HTTP_PORT" \
+    --http --http-port "$HTTP_PORT" --http-backend "$BACKEND" \
     --grpc --grpc-port "$GRPC_PORT" \
-    --redis --redis-port "$REDIS_PORT" \
+    --redis --redis-port "$REDIS_PORT" --redis-backend "$BACKEND" \
     --store adaptive --log-level warn &
 SERVER_PID=$!
 trap 'kill "$SERVER_PID" 2>/dev/null || true' EXIT
@@ -54,10 +70,22 @@ for _ in $(seq 1 120); do
 done
 curl -sf -m 2 "localhost:$HTTP_PORT/health" >/dev/null
 
+# Warmup: drive every selected transport through the first-touch compiles
+# so the timed run measures steady state, not XLA compilation.
+if [[ "$WARM" -gt 0 ]]; then
+  python -m throttlecrab_tpu.harness perf-test \
+      --transport "$TRANSPORT" \
+      --port "$HTTP_PORT" --grpc-port "$GRPC_PORT" \
+      --redis-port "$REDIS_PORT" \
+      --workers 4 --requests "$WARM" --key-pattern zipfian \
+      >/dev/null
+fi
+
 python -m throttlecrab_tpu.harness perf-test \
     --transport "$TRANSPORT" \
     --port "$HTTP_PORT" --grpc-port "$GRPC_PORT" --redis-port "$REDIS_PORT" \
-    --workers "$WORKERS" --requests "$REQUESTS" --key-pattern zipfian
+    --workers "$WORKERS" --requests "$REQUESTS" --key-pattern zipfian \
+    --pipeline "$PIPELINE" --procs "$PROCS"
 
 kill "$SERVER_PID" 2>/dev/null || true
 wait "$SERVER_PID" 2>/dev/null || true

@@ -140,6 +140,9 @@ NODES = f"127.0.0.1:{RPC_A},127.0.0.1:{RPC_B}"
 def spawn_node(index: int, http_port: int):
     env = dict(os.environ)
     env["THROTTLECRAB_PLATFORM"] = "cpu"
+    # First-touch jit compiles on the CPU backend take 10-40 s; the
+    # serving-grade 250 ms forward deadline would expire mid-compile.
+    env["THROTTLECRAB_CLUSTER_TIMEOUT_MS"] = "60000"
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.Popen(
@@ -296,3 +299,154 @@ def test_unencodable_key_fails_only_itself():
     res = cl.rate_limit_batch(keys, 5, 100, 60, 1, T0)
     assert res.allowed.tolist() == [True, False, True]
     assert res.status[1] != 0 and res.status[0] == 0 and res.status[2] == 0
+
+
+# ------------------------------------------------ failure containment #
+
+
+def _silent_listener():
+    """A TCP listener that accepts and then never replies (a hung peer —
+    worse than a dead one, because connect succeeds)."""
+    import socket as _socket
+    import threading as _threading
+
+    srv = _socket.socket()
+    srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    conns = []
+    stop = _threading.Event()
+
+    def loop():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+                conns.append(c)
+            except OSError:
+                continue
+
+    t = _threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    def close():
+        stop.set()
+        t.join(timeout=2)
+        for c in conns:
+            c.close()
+        srv.close()
+
+    return srv.getsockname()[1], close
+
+
+def test_silent_peer_fails_within_deadline_local_keys_unaffected():
+    """An accepted-but-silent peer must cost at most the configured
+    forward deadline, fail ONLY its own keys, and leave local keys
+    deciding at full speed (round-3 weakness #6: the old 30 s IO timeout
+    stalled every batch)."""
+    port, close = _silent_listener()
+    try:
+        local = TpuRateLimiter(capacity=256)
+        cl = ClusterLimiter(
+            local, [f"127.0.0.1:{port}", "127.0.0.1:1"], 1,
+            io_timeout_s=0.3, breaker_failures=99,  # breaker off: pure deadline
+        )
+        key_remote = next(
+            f"sp:{i}" for i in range(10_000)
+            if node_of_key(f"sp:{i}".encode(), 2) == 0
+        )
+        key_local = next(
+            f"sl:{i}" for i in range(10_000)
+            if node_of_key(f"sl:{i}".encode(), 2) == 1
+        )
+        # Warm the local compile outside the timed window.
+        cl.rate_limit_batch([key_local], 5, 100, 60, 1, T0)
+
+        t0 = time.monotonic()
+        res = cl.rate_limit_batch(
+            [key_remote, key_local], 5, 100, 60, 1, T0 + NS
+        )
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, f"hung peer stalled the batch {elapsed:.1f}s"
+        assert res.allowed.tolist() == [False, True]
+        assert res.status[0] != 0 and res.status[1] == 0
+    finally:
+        close()
+
+
+def test_circuit_breaker_opens_and_recovers():
+    """After N consecutive failures the breaker opens (fail-fast, no
+    network touch); after the cooldown one probe goes through again."""
+    from throttlecrab_tpu.parallel.cluster import PeerConnection, PeerUnavailable
+
+    fake_now = [0.0]
+    peer = PeerConnection(
+        "127.0.0.1", 1, io_timeout_s=0.1, connect_timeout_s=0.1,
+        breaker_failures=3, breaker_cooldown_s=5.0,
+        clock=lambda: fake_now[0],
+    )
+    # Three real failures arm the breaker (connection refused each time).
+    for i in range(3):
+        fake_now[0] += 10.0  # clear any backoff between attempts
+        with pytest.raises(OSError):
+            peer.send_frame(b"x")
+        peer.record_failure()
+    # Inside the cooldown: fail-fast without touching the network.
+    with pytest.raises(PeerUnavailable):
+        peer.send_frame(b"x")
+    # After the cooldown a probe attempt is allowed through again (and
+    # hits the real refused connection, not the gate).
+    fake_now[0] += 5.1
+    with pytest.raises(OSError) as exc:
+        peer.send_frame(b"x")
+    assert not isinstance(exc.value, PeerUnavailable)
+
+
+def test_reconnect_backoff_gates_attempts():
+    from throttlecrab_tpu.parallel.cluster import PeerConnection, PeerUnavailable
+
+    fake_now = [100.0]
+    peer = PeerConnection(
+        "127.0.0.1", 1, connect_timeout_s=0.1,
+        breaker_failures=99, clock=lambda: fake_now[0],
+    )
+    with pytest.raises(OSError):
+        peer.send_frame(b"x")
+    peer.record_failure()
+    # Immediately after the failure: gated, no network touch.
+    with pytest.raises(PeerUnavailable):
+        peer.send_frame(b"x")
+    # Past the first backoff window (50 ms): real attempt again.
+    fake_now[0] += 0.06
+    with pytest.raises(OSError) as exc:
+        peer.send_frame(b"x")
+    assert not isinstance(exc.value, PeerUnavailable)
+
+
+def test_cluster_batch_failfast_when_breaker_open():
+    """A whole batch with a breaker-open peer resolves instantly: remote
+    keys STATUS_INTERNAL, local keys decided."""
+    local = TpuRateLimiter(capacity=256)
+    cl = ClusterLimiter(
+        local, ["127.0.0.1:1", "127.0.0.1:2"], 1,
+        io_timeout_s=0.1, connect_timeout_s=0.1,
+        breaker_failures=1, breaker_cooldown_s=60.0,
+    )
+    key_remote = next(
+        f"bf:{i}" for i in range(10_000)
+        if node_of_key(f"bf:{i}".encode(), 2) == 0
+    )
+    key_local = next(
+        f"bl:{i}" for i in range(10_000)
+        if node_of_key(f"bl:{i}".encode(), 2) == 1
+    )
+    cl.rate_limit_batch([key_local], 5, 100, 60, 1, T0)  # warm compile
+    cl.rate_limit_batch([key_remote], 5, 100, 60, 1, T0)  # arms breaker
+    t0 = time.monotonic()
+    res = cl.rate_limit_batch(
+        [key_remote, key_local], 5, 100, 60, 1, T0 + NS
+    )
+    assert time.monotonic() - t0 < 0.5
+    assert res.allowed.tolist() == [False, True]
+    stats = cl.peer_stats()
+    assert stats["127.0.0.1:1"]["failed"] >= 2

@@ -210,3 +210,53 @@ def test_oversized_wave_splits_into_batches():
 
     results = run(main())
     assert all(r.allowed for r in results)  # 20 per key < burst 50
+
+
+def test_double_buffered_backlog_preserves_exactness():
+    """A deep backlog drains through overlapped dispatch/fetch launches;
+    the burst accounting must stay exact across the launch boundary."""
+
+    async def main():
+        engine, _ = make_engine(
+            batch_size=8, max_linger_us=500, max_scan_depth=2
+        )
+        # 64 concurrent hits on one burst-24 key: several scan windows,
+        # dispatched with window N+1 in flight before N is fetched.
+        results = await asyncio.gather(
+            *[engine.throttle(req(key="db", burst=24, period=3600))
+              for _ in range(64)]
+        )
+        return results
+
+    results = run(main())
+    assert sum(r.allowed for r in results) == 24
+
+
+def test_dispatch_failure_fails_only_its_window():
+    """A dispatch exception must fail that window's futures and leave the
+    engine serving later requests."""
+
+    async def main():
+        engine, _ = make_engine(batch_size=4, max_linger_us=500)
+        orig = engine.limiter.dispatch_many
+        calls = {"n": 0}
+
+        def flaky(batches, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected dispatch failure")
+            return orig(batches, **kw)
+
+        engine.limiter.dispatch_many = flaky
+        first = await asyncio.gather(
+            *[engine.throttle(req(key=f"f{i}")) for i in range(4)],
+            return_exceptions=True,
+        )
+        second = await asyncio.gather(
+            *[engine.throttle(req(key=f"g{i}")) for i in range(4)]
+        )
+        return first, second
+
+    first, second = run(main())
+    assert all(isinstance(r, ThrottleError) for r in first)
+    assert all(r.allowed for r in second)

@@ -109,7 +109,11 @@ class HttpClient:
 
 
 class RedisClient:
-    """RESP client issuing THROTTLE commands."""
+    """RESP client issuing THROTTLE commands.
+
+    Supports pipelining (`throttle_many`): N commands written in one
+    burst, then N responses parsed in order — the mode behind the
+    pipelined throughput numbers in docs/benchmark-results.md."""
 
     def __init__(self, host: str, port: int) -> None:
         self.host, self.port = host, port
@@ -122,26 +126,97 @@ class RedisClient:
             self.host, self.port
         )
 
-    async def throttle(self, key: str, burst: int, count: int, period: int):
+    @staticmethod
+    def _frame(key: str, burst: int, count: int, period: int) -> bytes:
         parts = [b"THROTTLE", key.encode(), str(burst).encode(),
                  str(count).encode(), str(period).encode()]
-        frame = b"*%d\r\n" % len(parts) + b"".join(
+        return b"*%d\r\n" % len(parts) + b"".join(
             b"$%d\r\n%s\r\n" % (len(p), p) for p in parts
         )
-        self.writer.write(frame)
-        await self.writer.drain()
-        # Response: *5 int array (or -ERR line).
-        while self._buf.count(b"\r\n") < 1:
-            self._buf += await self.reader.read(4096)
-        if self._buf.startswith(b"-"):
-            line, _, self._buf = self._buf.partition(b"\r\n")
+
+    async def _readline(self) -> bytes:
+        idx = self._buf.find(b"\r\n")
+        while idx < 0:
+            chunk = await self.reader.read(65536)
+            if not chunk:
+                raise ConnectionError("server closed mid-response")
+            self._buf += chunk
+            idx = self._buf.find(b"\r\n", max(len(self._buf) - len(chunk) - 1, 0))
+        line, self._buf = self._buf[:idx], self._buf[idx + 2 :]
+        return line
+
+    async def _read_response(self):
+        """One RESP response: *5 int array → allowed bool; -ERR → None."""
+        line = await self._readline()
+        if line.startswith(b"-"):
             return None
-        while self._buf.count(b"\r\n") < 6:
-            self._buf += await self.reader.read(4096)
-        lines = self._buf.split(b"\r\n")
-        allowed = lines[1] == b":1"
-        self._buf = b"\r\n".join(lines[6:])
-        return allowed
+        if line.startswith(b"*"):
+            n = int(line[1:])
+            vals = [await self._readline() for _ in range(n)]
+            return vals[0] == b":1"
+        return None
+
+    async def throttle(self, key: str, burst: int, count: int, period: int):
+        self.writer.write(self._frame(key, burst, count, period))
+        await self.writer.drain()
+        return await self._read_response()
+
+    async def throttle_many(
+        self, keys, burst: int, count: int, period: int
+    ):
+        """Pipelined: len(keys) commands in one write, responses in
+        order (the server guarantees pipelined ordering — test_resp.py).
+
+        Responses are parsed token-wise from whole buffers (one C-speed
+        split per read) — per-line asyncio reads cap a pipelined client
+        at ~30 K resp/s, an order of magnitude under the server."""
+        self.writer.write(
+            b"".join(self._frame(k, burst, count, period) for k in keys)
+        )
+        await self.writer.drain()
+        need = len(keys)
+        outcomes: List = []
+        tokens: List[bytes] = self._buf.split(b"\r\n") if self._buf else [b""]
+        carry = tokens.pop()  # possibly-partial trailing line
+        i = 0
+        while len(outcomes) < need:
+            # Parse as many complete responses as the tokens allow.
+            made_progress = True
+            while len(outcomes) < need and made_progress:
+                made_progress = False
+                if i >= len(tokens):
+                    break
+                head = tokens[i]
+                if head.startswith(b"-"):
+                    outcomes.append(None)
+                    i += 1
+                    made_progress = True
+                elif head.startswith(b"*"):
+                    n = int(head[1:])
+                    if i + n < len(tokens):
+                        outcomes.append(tokens[i + 1] == b":1")
+                        i += n + 1
+                        made_progress = True
+                elif head == b"":
+                    i += 1
+                    made_progress = True
+                else:  # +simple string (not expected for THROTTLE)
+                    outcomes.append(None)
+                    i += 1
+                    made_progress = True
+            if len(outcomes) >= need:
+                break
+            chunk = await self.reader.read(1 << 20)
+            if not chunk:
+                raise ConnectionError("server closed mid-pipeline")
+            fresh = (carry + chunk).split(b"\r\n")
+            carry = fresh.pop()
+            tokens = tokens[i:] + fresh
+            i = 0
+        # Preserve any unconsumed bytes for subsequent reads.
+        rest = tokens[i:]
+        self._buf = b"\r\n".join(rest + [carry]) if (rest or carry) else b""
+        return outcomes
 
     async def close(self) -> None:
         if self.writer:
@@ -202,9 +277,16 @@ async def run_perf_test(
     key_space: int = 10_000,
     workload: str = "steady",
     target_rps: float = 0.0,
+    pipeline: int = 1,
 ) -> PerfResult:
     """Barrier-synchronized workers, pre-generated keys
-    (perf_test_multi_transport.rs:48-127)."""
+    (perf_test_multi_transport.rs:48-127).
+
+    `pipeline` > 1 (RESP only) sends that many commands per write before
+    reading the responses; recorded latency is then per *window* — the
+    time until the whole window's responses are parsed."""
+    if pipeline > 1 and transport != "redis":
+        raise ValueError("--pipeline requires the redis transport")
     clients = [CLIENTS[transport](host, port) for _ in range(workers)]
     await asyncio.gather(*(c.connect() for c in clients))
 
@@ -215,11 +297,40 @@ async def run_perf_test(
     barrier = asyncio.Barrier(workers)
     result = PerfResult(transport, 0, 0.0, 0, 0, 0)
 
+    def tally(allowed) -> None:
+        if allowed is None:
+            result.errors += 1
+        elif allowed:
+            result.allowed += 1
+        else:
+            result.denied += 1
+
     async def worker(w: int) -> None:
         client = clients[w]
         keys = all_keys[w]
         wl = Workload(workload, target_rps, requests_per_worker)
         await barrier.wait()
+        if pipeline > 1:
+            for start in range(0, len(keys), pipeline):
+                window = keys[start : start + pipeline]
+                t0 = time.perf_counter()
+                try:
+                    outcomes = await client.throttle_many(
+                        window, burst, count, period
+                    )
+                except Exception:
+                    result.errors += len(window)
+                    try:
+                        await client.close()
+                        await client.connect()
+                    except Exception:
+                        result.errors += len(keys) - start - len(window)
+                        return
+                    continue
+                result.latencies_s.append(time.perf_counter() - t0)
+                for allowed in outcomes:
+                    tally(allowed)
+            return
         for done, (key, delay) in enumerate(zip(keys, wl.delays())):
             if delay > 0:
                 await asyncio.sleep(delay)
@@ -239,12 +350,7 @@ async def run_perf_test(
                     return
                 continue
             result.latencies_s.append(time.perf_counter() - t0)
-            if allowed is None:
-                result.errors += 1
-            elif allowed:
-                result.allowed += 1
-            else:
-                result.denied += 1
+            tally(allowed)
 
     t_start = time.perf_counter()
     await asyncio.gather(*(worker(w) for w in range(workers)))
@@ -275,6 +381,13 @@ def main(argv=None) -> int:
                    choices=["steady", "burst", "ramp", "wave"])
     p.add_argument("--target-rps", type=float, default=0.0,
                    help="per-worker pacing (0 = open throttle)")
+    p.add_argument("--pipeline", type=int, default=1,
+                   help="RESP only: commands pipelined per write "
+                        "(reproduces the pipelined throughput numbers)")
+    p.add_argument("--procs", type=int, default=1,
+                   help="worker processes (a single Python process "
+                        "saturates around ~50K resp/s client-side; the "
+                        "reference harness is compiled Rust)")
     p.add_argument("--burst", type=int, default=100)
     p.add_argument("--count", type=int, default=10_000)
     p.add_argument("--period", type=int, default=60)
@@ -284,20 +397,82 @@ def main(argv=None) -> int:
         ["http", "grpc", "redis"] if args.transport == "all"
         else [args.transport]
     )
+    if args.pipeline > 1 and transports != ["redis"]:
+        print("error: --pipeline requires --transport redis",
+              file=sys.stderr)
+        return 2
     ports = {"http": args.port, "grpc": args.grpc_port,
              "redis": args.redis_port}
     for transport in transports:
-        result = asyncio.run(
-            run_perf_test(
-                transport, args.host, ports[transport], args.workers,
-                args.requests, burst=args.burst, count=args.count,
-                period=args.period, key_pattern=args.key_pattern,
-                key_space=args.key_space, workload=args.workload,
-                target_rps=args.target_rps,
-            )
+        kwargs = dict(
+            burst=args.burst, count=args.count, period=args.period,
+            key_pattern=args.key_pattern, key_space=args.key_space,
+            workload=args.workload, target_rps=args.target_rps,
+            pipeline=args.pipeline,
         )
-        print(json.dumps(result.summary()))
+        if args.procs > 1:
+            result = run_multiproc(
+                transport, args.host, ports[transport], args.workers,
+                args.requests, args.procs, kwargs,
+            )
+        else:
+            result = asyncio.run(
+                run_perf_test(
+                    transport, args.host, ports[transport], args.workers,
+                    args.requests, **kwargs,
+                )
+            )
+        summary = result.summary()
+        if args.pipeline > 1:
+            summary["pipeline"] = args.pipeline
+        if args.procs > 1:
+            summary["procs"] = args.procs
+        print(json.dumps(summary))
     return 0
+
+
+def _proc_entry(transport, host, port, workers, requests, kwargs):
+    result = asyncio.run(
+        run_perf_test(transport, host, port, workers, requests, **kwargs)
+    )
+    return (
+        result.total_requests, result.elapsed_s, result.allowed,
+        result.denied, result.errors, result.latencies_s,
+    )
+
+
+def run_multiproc(
+    transport, host, port, workers, requests, procs, kwargs
+) -> PerfResult:
+    """Fan the load across OS processes (one asyncio loop each): a single
+    Python process tops out around ~50K pipelined resp/s of client-side
+    parsing, well under the native server's capacity."""
+    import multiprocessing as mp
+
+    if workers % procs != 0:
+        raise ValueError(
+            f"--workers ({workers}) must be a multiple of --procs "
+            f"({procs}) so the measured load matches the flags"
+        )
+    per_proc = workers // procs
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(procs) as pool:
+        parts = pool.starmap(
+            _proc_entry,
+            [
+                (transport, host, port, per_proc, requests, kwargs)
+                for _ in range(procs)
+            ],
+        )
+    merged = PerfResult(transport, 0, 0.0, 0, 0, 0)
+    for total, elapsed, allowed, denied, errors, lats in parts:
+        merged.total_requests += total
+        merged.elapsed_s = max(merged.elapsed_s, elapsed)
+        merged.allowed += allowed
+        merged.denied += denied
+        merged.errors += errors
+        merged.latencies_s.extend(lats)
+    return merged
 
 
 if __name__ == "__main__":

@@ -246,11 +246,13 @@ class NativeKeyMap:
         buf = b"".join(keys)
         offsets = np.zeros(n + 1, np.int64)
         np.cumsum([len(k) for k in keys], out=offsets[1:])
-        return int(
+        first = int(
             self._lib.tk_intern_keys(
                 self._h, buf, offsets.ctypes.data_as(ctypes.c_void_p), n
             )
         )
+        self._n_ids = first + n
+        return first
 
     def assemble(
         self,
@@ -268,6 +270,16 @@ class NativeKeyMap:
         micro-batch.  Returns (packed i32[total, PACK_WIDTH], n_full)."""
         from .tpu.kernel import PACK_WIDTH
 
+        if batch <= 0:
+            raise ValueError("batch must be positive")
+        # The C side indexes em/tol by id with no bounds check — the
+        # parameter tables must cover every interned id.
+        n_ids = getattr(self, "_n_ids", 0)
+        if len(em_by_id) < n_ids or len(tol_by_id) < n_ids:
+            raise ValueError(
+                f"parameter tables must cover all {n_ids} interned ids "
+                f"(got {len(em_by_id)}/{len(tol_by_id)})"
+            )
         ids = np.ascontiguousarray(ids, np.int32)
         total = len(ids)
         if out is None:

@@ -164,32 +164,123 @@ def decode_reply(body: bytes):
     return np.frombuffer(body, _REP_DTYPE, count=n, offset=_REP_HEAD.size)
 
 
+class PeerUnavailable(ConnectionError):
+    """Raised without touching the network: the peer's circuit is open or
+    its reconnect backoff has not elapsed.  A hung or flapping peer must
+    cost the batch path ~nothing — only its own keys fail."""
+
+
 class PeerConnection:
     """One persistent blocking TCP connection to a peer node.
 
     Used from the engine's executor thread (decisions are already off the
     event loop); a lock serializes request/reply cycles.  Frames can be
     pipelined: send_frame() N times, then recv_frame() N times in order.
+
+    Failure containment (round-4 hardening — a hung peer used to stall
+    every batch for IO_TIMEOUT_S=30 s):
+
+    - `io_timeout_s` is a serving-grade per-operation deadline (default
+      250 ms): an accepted-but-silent peer fails its requests within the
+      deadline instead of wedging the pipeline.
+    - after a failure, reconnect attempts back off exponentially
+      (BACKOFF_MIN_S → BACKOFF_MAX_S); attempts inside the backoff window
+      raise PeerUnavailable immediately, without touching the network.
+    - BREAKER_FAILURES consecutive failures open a circuit breaker for
+      BREAKER_COOLDOWN_S: the peer is assumed down and its keys fail
+      instantly until one probe attempt is allowed through.
     """
 
     CONNECT_TIMEOUT_S = 5.0
-    IO_TIMEOUT_S = 30.0
+    IO_TIMEOUT_S = 0.25
+    BACKOFF_MIN_S = 0.05
+    BACKOFF_MAX_S = 2.0
+    BREAKER_FAILURES = 3
+    BREAKER_COOLDOWN_S = 1.0
 
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        io_timeout_s: Optional[float] = None,
+        connect_timeout_s: Optional[float] = None,
+        breaker_failures: Optional[int] = None,
+        breaker_cooldown_s: Optional[float] = None,
+        clock=None,
+    ) -> None:
+        import time
+
         self.host = host
         self.port = port
+        self.io_timeout_s = (
+            self.IO_TIMEOUT_S if io_timeout_s is None else io_timeout_s
+        )
+        self.connect_timeout_s = (
+            self.CONNECT_TIMEOUT_S
+            if connect_timeout_s is None
+            else connect_timeout_s
+        )
+        self.breaker_failures = (
+            self.BREAKER_FAILURES
+            if breaker_failures is None
+            else breaker_failures
+        )
+        self.breaker_cooldown_s = (
+            self.BREAKER_COOLDOWN_S
+            if breaker_cooldown_s is None
+            else breaker_cooldown_s
+        )
+        self._clock = clock or time.monotonic
         self.lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+        self._consecutive_failures = 0
+        self._retry_at = 0.0  # monotonic deadline gating the next attempt
+        # Diagnostics / metrics (read under self.lock or approximately).
+        self.forwarded = 0
+        self.failed = 0
+
+    def _check_gate(self) -> None:
+        if self._sock is None and self._clock() < self._retry_at:
+            state = (
+                "circuit open"
+                if self._consecutive_failures >= self.breaker_failures
+                else "reconnect backoff"
+            )
+            raise PeerUnavailable(
+                f"peer {self.host}:{self.port} unavailable ({state}, "
+                f"{self._consecutive_failures} consecutive failures)"
+            )
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
+            self._check_gate()
             s = socket.create_connection(
-                (self.host, self.port), self.CONNECT_TIMEOUT_S
+                (self.host, self.port), self.connect_timeout_s
             )
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            s.settimeout(self.IO_TIMEOUT_S)
+            s.settimeout(self.io_timeout_s)
             self._sock = s
         return self._sock
+
+    def record_success(self) -> None:
+        self._consecutive_failures = 0
+        self._retry_at = 0.0
+        self.forwarded += 1
+
+    def record_failure(self) -> None:
+        """Close the connection and arm the backoff / circuit breaker."""
+        self.failed += 1
+        self._consecutive_failures += 1
+        if self._consecutive_failures >= self.breaker_failures:
+            delay = self.breaker_cooldown_s
+        else:
+            delay = min(
+                self.BACKOFF_MIN_S
+                * (2 ** (self._consecutive_failures - 1)),
+                self.BACKOFF_MAX_S,
+            )
+        self._retry_at = self._clock() + delay
+        self.close()
 
     def close(self) -> None:
         if self._sock is not None:
@@ -233,10 +324,17 @@ class ClusterLimiter(ScalarCompatMixin):
         local,
         nodes: Sequence[str],
         self_index: int,
+        io_timeout_s: Optional[float] = None,
+        connect_timeout_s: Optional[float] = None,
+        breaker_failures: Optional[int] = None,
+        breaker_cooldown_s: Optional[float] = None,
     ) -> None:
         """`nodes` lists every node's cluster RPC address host:port (the
         same list, in the same order, on every node); `self_index` is this
-        node's position in it."""
+        node's position in it.  The timeout/breaker knobs configure each
+        PeerConnection's failure containment (see its docstring).  For
+        per-peer observability, point the server's Metrics at
+        `peer_stats` via set_cluster_stats_provider (run_server does)."""
         if not 0 <= self_index < len(nodes):
             raise ValueError("self_index out of range")
         self.local = local
@@ -256,7 +354,27 @@ class ClusterLimiter(ScalarCompatMixin):
                 self.peers.append(None)
             else:
                 host, _, port = addr.rpartition(":")
-                self.peers.append(PeerConnection(host, int(port)))
+                self.peers.append(
+                    PeerConnection(
+                        host,
+                        int(port),
+                        io_timeout_s=io_timeout_s,
+                        connect_timeout_s=connect_timeout_s,
+                        breaker_failures=breaker_failures,
+                        breaker_cooldown_s=breaker_cooldown_s,
+                    )
+                )
+
+    def peer_stats(self) -> dict:
+        """{peer_addr: {"forwarded": n, "failed": n}} for observability."""
+        return {
+            self.nodes[i]: {
+                "forwarded": peer.forwarded,
+                "failed": peer.failed,
+            }
+            for i, peer in enumerate(self.peers)
+            if peer is not None
+        }
 
     # ------------------------------------------------------------------ #
 
@@ -306,10 +424,15 @@ class ClusterLimiter(ScalarCompatMixin):
 
     def rate_limit_batch(
         self, keys, max_burst, count_per_period, period, quantity,
-        now_ns: int, wire: bool = False,
+        now_ns: int, wire: bool = False, _part=None,
     ):
+        """`_part` lets rate_limit_many pass the partition it already
+        computed for its local-only probe, so no batch is partitioned
+        twice."""
         n = len(keys)
-        kb, bad, by_node = self._encode_and_partition(keys)
+        kb, bad, by_node = (
+            self._encode_and_partition(keys) if _part is None else _part
+        )
         mb = self._broadcast(max_burst, n)
         cp = self._broadcast(count_per_period, n)
         pd = self._broadcast(period, n)
@@ -332,9 +455,18 @@ class ClusterLimiter(ScalarCompatMixin):
                 with peer.lock:
                     peer.send_frame(frame)
                 sent.append((d, ix))
+            except PeerUnavailable:
+                # Gate already armed by the original failure; re-arming
+                # here would push the retry deadline forever outward.
+                with peer.lock:
+                    peer.failed += 1
+                failed_nodes.append((d, ix))
             except OSError as e:
-                log.warning("cluster peer %s send failed: %s", self.nodes[d], e)
-                peer.close()
+                log.warning(
+                    "cluster peer %s send failed: %s", self.nodes[d], e
+                )
+                with peer.lock:
+                    peer.record_failure()
                 failed_nodes.append((d, ix))
 
         local_ix = by_node[self.self_index]
@@ -381,14 +513,17 @@ class ClusterLimiter(ScalarCompatMixin):
                     )
             except (OSError, struct.error) as e:
                 # A malformed frame leaves the stream desynced: drop the
-                # connection so the next batch reconnects cleanly, and
-                # fail only this peer's requests.
+                # connection so the next batch reconnects cleanly (after
+                # backoff), and fail only this peer's requests.
                 log.warning(
                     "cluster peer %s reply failed: %s", self.nodes[d], e
                 )
-                peer.close()
+                with peer.lock:
+                    peer.record_failure()
                 failed_nodes.append((d, ix))
                 continue
+            with peer.lock:
+                peer.record_success()
             status[ix] = rep["status"]
             allowed[ix] = rep["allowed"] != 0
             limit[ix] = rep["limit"]
@@ -441,21 +576,26 @@ class ClusterLimiter(ScalarCompatMixin):
         if not batches:
             return []
         can_scan = hasattr(self.local, "rate_limit_many")
+        # Partition each batch exactly once: the local-only probe hands its
+        # partitions to the per-batch path instead of discarding them.
+        parts = [self._encode_and_partition(b[0]) for b in batches]
         if can_scan and len(batches) > 1:
-            local_only = True
-            for b in batches:
-                _, bad, by_node = self._encode_and_partition(b[0])
-                if bad.any() or any(
+            local_only = all(
+                not bad.any()
+                and not any(
                     len(ix)
                     for d, ix in enumerate(by_node)
                     if d != self.self_index
-                ):
-                    local_only = False
-                    break
+                )
+                for _, bad, by_node in parts
+            )
             if local_only:
                 with self.device_lock:
                     return self.local.rate_limit_many(batches, wire=wire)
-        return [self.rate_limit_batch(*b, wire=wire) for b in batches]
+        return [
+            self.rate_limit_batch(*b, wire=wire, _part=part)
+            for b, part in zip(batches, parts)
+        ]
 
     # ------------------------------------------------------------------ #
 

@@ -44,6 +44,7 @@ def build_transports(config: Config, engine, metrics):
                     metrics,
                     batch_size=config.batch_size,
                     max_linger_us=config.max_linger_us,
+                    max_scan_depth=config.max_scan_depth,
                     cleanup_policy=engine.cleanup_policy,
                     limiter_lock=engine.limiter_lock,
                     now_fn=engine.now_fn,
@@ -80,6 +81,7 @@ def build_transports(config: Config, engine, metrics):
                     metrics,
                     batch_size=config.batch_size,
                     max_linger_us=config.max_linger_us,
+                    max_scan_depth=config.max_scan_depth,
                     cleanup_policy=native_policy,
                     limiter_lock=engine.limiter_lock,
                     now_fn=engine.now_fn,
@@ -115,12 +117,18 @@ async def run_server(config: Config) -> None:
             cluster_nodes[config.cluster_index],
         )
         limiter = ClusterLimiter(
-            limiter, cluster_nodes, config.cluster_index
+            limiter, cluster_nodes, config.cluster_index,
+            io_timeout_s=config.cluster_timeout_ms / 1000.0,
+            breaker_failures=config.cluster_breaker_failures,
+            breaker_cooldown_s=config.cluster_breaker_cooldown_ms / 1000.0,
+            connect_timeout_s=config.cluster_timeout_ms / 1000.0,
         )
+        metrics.set_cluster_stats_provider(limiter.peer_stats)
     engine = BatchingEngine(
         limiter,
         batch_size=config.batch_size,
         max_linger_us=config.max_linger_us,
+        max_scan_depth=config.max_scan_depth,
         cleanup_policy=create_cleanup_policy(config),
         metrics=metrics,
         profile_dir=config.profile_dir or None,

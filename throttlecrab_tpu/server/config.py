@@ -64,6 +64,8 @@ _SPEC = [
      "Max requests coalesced into one device launch"),
     ("max_linger_us", "THROTTLECRAB_MAX_LINGER_US", 200, int,
      "Max microseconds a request waits for its batch to fill"),
+    ("max_scan_depth", "THROTTLECRAB_MAX_SCAN_DEPTH", 16, int,
+     "Max backlog sub-batches decided in one device launch"),
     ("keymap", "THROTTLECRAB_KEYMAP", "auto", str,
      "Host key->slot backend: auto, python, native"),
     ("shards", "THROTTLECRAB_SHARDS", 1, int,
@@ -77,6 +79,13 @@ _SPEC = [
      "This node's position in --cluster-nodes"),
     ("cluster_bind_host", "THROTTLECRAB_CLUSTER_BIND_HOST", "0.0.0.0", str,
      "Bind host for the cluster RPC listener"),
+    ("cluster_timeout_ms", "THROTTLECRAB_CLUSTER_TIMEOUT_MS", 250, int,
+     "Per-peer forward deadline in milliseconds"),
+    ("cluster_breaker_failures", "THROTTLECRAB_CLUSTER_BREAKER_FAILURES",
+     3, int, "Consecutive peer failures that open the circuit breaker"),
+    ("cluster_breaker_cooldown_ms",
+     "THROTTLECRAB_CLUSTER_BREAKER_COOLDOWN_MS", 1000, int,
+     "Circuit-breaker cooldown before the next probe (milliseconds)"),
 ]
 
 
@@ -105,12 +114,16 @@ class Config:
     log_level: str = "info"
     batch_size: int = 4096
     max_linger_us: int = 200
+    max_scan_depth: int = 16
     keymap: str = "auto"
     shards: int = 1
     profile_dir: str = ""
     cluster_nodes: str = ""
     cluster_index: int = 0
     cluster_bind_host: str = "0.0.0.0"
+    cluster_timeout_ms: int = 250
+    cluster_breaker_failures: int = 3
+    cluster_breaker_cooldown_ms: int = 1000
 
     @classmethod
     def from_env_and_args(

@@ -60,10 +60,12 @@ class BatchingEngine:
         now_fn=None,
         profile_dir: Optional[str] = None,
         profile_launches: int = 50,
+        max_scan_depth: int = 16,
     ) -> None:
         """`limiter` is a TpuRateLimiter / ShardedTpuRateLimiter (or any
         object with rate_limit_batch + sweep).  `now_fn` injects time for
-        tests (time is an input, never ambient — rate_limiter.rs:109)."""
+        tests (time is an input, never ambient — rate_limiter.rs:109).
+        `max_scan_depth` caps backlog sub-batches decided per launch."""
         import threading
         import time
 
@@ -94,9 +96,13 @@ class BatchingEngine:
         self.cleanup_policy = cleanup_policy
         self.metrics = metrics
         self.now_fn = now_fn or time.time_ns
-        self._pending: List[
-            Tuple[ThrottleRequest, asyncio.Future]
-        ] = []
+        self.max_scan_depth = max_scan_depth
+        from collections import deque
+
+        # deque: the flush loop pops whole windows from the left while
+        # transports append on the right — the old list paid O(n) element
+        # shifting per launch (`del pending[:take]`).
+        self._pending: deque = deque()
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._flush_lock = asyncio.Lock()
         self._closed = False
@@ -140,39 +146,117 @@ class BatchingEngine:
         self._flush_tasks.add(task)
         task.add_done_callback(self._flush_tasks.discard)
 
-    MAX_SCAN_DEPTH = 16  # backlog sub-batches decided per launch
-
     async def _flush(self) -> None:
         """Decide everything pending (in arrival order).
 
-        A backlog deeper than one batch drains through the scan path —
-        up to MAX_SCAN_DEPTH full batches in a single device launch
-        (limiter.rate_limit_many), amortizing the fixed dispatch cost."""
+        A backlog deeper than one batch drains through the scan path — up
+        to max_scan_depth full batches in a single device launch
+        (limiter.rate_limit_many), amortizing the fixed dispatch cost.
+
+        When the limiter offers the dispatch/fetch split (dispatch_many),
+        the loop double-buffers: window N+1 is assembled and dispatched
+        while the device still executes window N, and only then are N's
+        results fetched — the host assembly cost hides behind device time
+        instead of adding to it (SURVEY §7.4 hard part 3)."""
         can_scan = hasattr(self.limiter, "rate_limit_many")
+        can_async = hasattr(self.limiter, "dispatch_many")
         async with self._flush_lock:
-            while self._pending:
-                n_batches = (
-                    min(
-                        max(len(self._pending) // self.batch_size, 1),
-                        self.MAX_SCAN_DEPTH,
-                    )
-                    if can_scan
-                    else 1
-                )
-                take = min(
-                    n_batches * self.batch_size, len(self._pending)
-                )
-                window = self._pending[:take]
-                del self._pending[:take]
-                if n_batches > 1:
-                    await self._decide_many(
-                        [
-                            window[i : i + self.batch_size]
-                            for i in range(0, take, self.batch_size)
-                        ]
-                    )
-                else:
-                    await self._decide(window)
+            if not can_async:
+                while self._pending:
+                    windows = self._take_windows(can_scan)
+                    if len(windows) > 1:
+                        await self._decide_many(windows)
+                    else:
+                        await self._decide(windows[0])
+                return
+
+            loop = asyncio.get_running_loop()
+            in_flight = None  # (windows, handle, now_ns)
+            while self._pending or in_flight is not None:
+                windows = self._take_windows(can_scan)
+                launched = None
+                if windows:
+                    now_ns = self.now_fn()
+                    self._profile_tick()
+
+                    def do_dispatch(ws=windows, t=now_ns):
+                        from ..tpu.profiling import annotate
+
+                        with self.limiter_lock, annotate("gcra_dispatch"):
+                            return self.limiter.dispatch_many(
+                                [
+                                    (
+                                        [r.key for r, _ in w],
+                                        [r.max_burst for r, _ in w],
+                                        [
+                                            r.count_per_period
+                                            for r, _ in w
+                                        ],
+                                        [r.period for r, _ in w],
+                                        [r.quantity for r, _ in w],
+                                        t,
+                                    )
+                                    for w in ws
+                                ],
+                                **self._wire_many_kw,
+                            )
+
+                    try:
+                        handle = await loop.run_in_executor(
+                            None, do_dispatch
+                        )
+                        launched = (windows, handle, now_ns)
+                    except Exception as exc:
+                        self._fail_windows(windows, exc)
+
+                if in_flight is not None:
+                    await self._fetch_complete(in_flight)
+                in_flight = launched
+            return
+
+    def _take_windows(self, can_scan: bool) -> list:
+        """Pop up to max_scan_depth × batch_size pending requests, chunked
+        into batch-sized windows (arrival order preserved)."""
+        if not self._pending:
+            return []
+        n_batches = (
+            min(
+                max(len(self._pending) // self.batch_size, 1),
+                self.max_scan_depth,
+            )
+            if can_scan
+            else 1
+        )
+        take = min(n_batches * self.batch_size, len(self._pending))
+        flat = [self._pending.popleft() for _ in range(take)]
+        return [
+            flat[i : i + self.batch_size]
+            for i in range(0, take, self.batch_size)
+        ]
+
+    @staticmethod
+    def _fail_windows(windows, exc) -> None:
+        for window in windows:
+            for _, fut in window:
+                if not fut.done():
+                    fut.set_exception(ThrottleError(str(exc)))
+
+    async def _fetch_complete(self, in_flight) -> None:
+        """Fetch an in-flight launch's results and resolve its futures."""
+        windows, handle, now_ns = in_flight
+        loop = asyncio.get_running_loop()
+        try:
+            results = await loop.run_in_executor(None, handle.fetch)
+        except Exception as exc:
+            self._fail_windows(windows, exc)
+            return
+        total = 0
+        for window, result in zip(windows, results):
+            total += len(window)
+            self._complete(window, result)
+        if self.metrics is not None:
+            self.metrics.record_launch(total)
+        await self._maybe_sweep(now_ns, total)
 
     async def _decide_many(self, windows) -> None:
         """Backlog path: K sub-batches, one launch, shared timestamp."""

@@ -147,6 +147,11 @@ class Metrics:
         self.sweeps += 1
         self.slots_freed += freed
 
+    def set_cluster_stats_provider(self, provider) -> None:
+        """`provider()` -> {peer_addr: {"forwarded": n, "failed": n}};
+        exported as per-peer counters (cluster deployments only)."""
+        self._cluster_stats = provider
+
     # ------------------------------------------------------------------ #
 
     def uptime_seconds(self) -> int:
@@ -244,6 +249,22 @@ class Metrics:
             "counter",
             self.slots_freed,
         )
+        provider = getattr(self, "_cluster_stats", None)
+        if provider is not None:
+            stats = provider()
+            for name, field, help_ in (
+                ("throttlecrab_cluster_forwarded_total", "forwarded",
+                 "Batches forwarded to each cluster peer"),
+                ("throttlecrab_cluster_failed_total", "failed",
+                 "Forward failures per cluster peer"),
+            ):
+                out.append(f"# HELP {name} {help_}")
+                out.append(f"# TYPE {name} counter")
+                for peer, counts in sorted(stats.items()):
+                    escaped = escape_label_value(peer)
+                    out.append(
+                        f'{name}{{peer="{escaped}"}} {counts[field]}'
+                    )
         return "\n".join(out) + "\n"
 
 

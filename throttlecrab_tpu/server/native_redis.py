@@ -52,6 +52,7 @@ class NativeRedisTransport:
         cleanup_policy=None,
         limiter_lock: Optional[threading.Lock] = None,
         now_fn=None,
+        max_scan_depth: int = 16,
     ) -> None:
         lib = get_wire_lib()
         if lib is None:
@@ -63,6 +64,7 @@ class NativeRedisTransport:
         self.metrics = metrics
         self.batch_size = batch_size
         self.max_linger_us = max_linger_us
+        self.max_scan_depth = max_scan_depth
         self.cleanup_policy = cleanup_policy
         self.limiter_lock = limiter_lock or threading.Lock()
         self.now_fn = now_fn or time.time_ns
@@ -124,9 +126,46 @@ class NativeRedisTransport:
 
     # ------------------------------------------------------------------ #
 
+    def _next_batch(self, linger_us: int) -> int:
+        return self._lib.ws_next_batch(
+            self._h,
+            linger_us,
+            self.batch_size,
+            self._key_buf,
+            len(self._key_buf),
+            self._offsets.ctypes.data_as(ctypes.c_void_p),
+            self._params.ctypes.data_as(ctypes.c_void_p),
+            self._cookie_gen.ctypes.data_as(ctypes.c_void_p),
+            self._cookie_fd.ctypes.data_as(ctypes.c_void_p),
+        )
+
+    def _capture(self, n: int):
+        """Snapshot the reusable batch buffers into per-batch arrays."""
+        offsets = self._offsets
+        # Copy only the used prefix, not the whole reusable buffer.
+        blob = ctypes.string_at(self._key_buf, int(offsets[n]))
+        keys = [blob[offsets[i] : offsets[i + 1]] for i in range(n)]
+        if not limiter_uses_bytes_keys(self.limiter):
+            # Match the identity the str-keyed transports use, so one
+            # client key maps to one bucket across HTTP/gRPC/RESP.
+            # surrogateescape keeps arbitrary bytes unique and lossless.
+            keys = [k.decode("utf-8", "surrogateescape") for k in keys]
+        p = self._params[: 4 * n]
+        return (
+            keys,
+            p[0::4].copy(), p[1::4].copy(), p[2::4].copy(), p[3::4].copy(),
+            self._cookie_gen[:n].copy(),
+            self._cookie_fd[:n].copy(),
+        )
+
     def _drive(self) -> None:
-        """The decide loop: block for a batch, decide, respond."""
+        """The decide loop: block for a batch; when a full batch arrives
+        (backlog — e.g. pipelined clients), drain up to max_scan_depth
+        further batches without lingering and decide the whole window in
+        ONE device launch (limiter.rate_limit_many), exactly like the
+        asyncio engine's backlog path."""
         B = self.batch_size
+        can_scan = hasattr(self.limiter, "rate_limit_many")
         self._push_metrics()
         last_metrics = time.monotonic()
         while self._running:
@@ -137,54 +176,62 @@ class NativeRedisTransport:
                 ):
                     self._push_metrics()
                     last_metrics = time.monotonic()
-                n = self._lib.ws_next_batch(
-                    self._h,
-                    self.max_linger_us,
-                    B,
-                    self._key_buf,
-                    len(self._key_buf),
-                    self._offsets.ctypes.data_as(ctypes.c_void_p),
-                    self._params.ctypes.data_as(ctypes.c_void_p),
-                    self._cookie_gen.ctypes.data_as(ctypes.c_void_p),
-                    self._cookie_fd.ctypes.data_as(ctypes.c_void_p),
-                )
+                n = self._next_batch(self.max_linger_us)
                 if n <= 0:
                     continue
-                self._decide(int(n))
+                batches = [self._capture(int(n))]
+                while (
+                    can_scan
+                    and n == B
+                    and len(batches) < self.max_scan_depth
+                ):
+                    n = self._next_batch(0)
+                    if n <= 0:
+                        break
+                    batches.append(self._capture(int(n)))
+                self._decide_window(batches)
             except Exception:
                 log.exception("native redis driver error")
                 if not self._running:
                     return
 
-    def _decide(self, n: int) -> None:
-        offsets = self._offsets
-        # Copy only the used prefix, not the whole reusable buffer.
-        blob = ctypes.string_at(self._key_buf, int(offsets[n]))
-        keys = [
-            blob[offsets[i] : offsets[i + 1]] for i in range(n)
-        ]
-        if not limiter_uses_bytes_keys(self.limiter):
-            # Match the identity the str-keyed transports use, so one
-            # client key maps to one bucket across HTTP/gRPC/RESP.
-            # surrogateescape keeps arbitrary bytes unique and lossless.
-            keys = [k.decode("utf-8", "surrogateescape") for k in keys]
-        p = self._params
+    def _decide_window(self, batches) -> None:
         now_ns = self.now_fn()
-        results = np.zeros(5 * n, np.int64)
         try:
             with self.limiter_lock:
                 # wire=True: compact i32 whole-second outputs straight off
                 # the device — the RESP/HTTP reply units — plus the
                 # degenerate-case kernel compiled out when certifiable.
-                res = self.limiter.rate_limit_batch(
-                    keys,
-                    p[0 : 4 * n : 4],
-                    p[1 : 4 * n : 4],
-                    p[2 : 4 * n : 4],
-                    p[3 : 4 * n : 4],
-                    now_ns,
-                    wire=True,
-                )
+                if len(batches) == 1:
+                    keys, mb, cp, pd, qt, _, _ = batches[0]
+                    results = [
+                        self.limiter.rate_limit_batch(
+                            keys, mb, cp, pd, qt, now_ns, wire=True
+                        )
+                    ]
+                else:
+                    results = self.limiter.rate_limit_many(
+                        [
+                            (keys, mb, cp, pd, qt, now_ns)
+                            for keys, mb, cp, pd, qt, _, _ in batches
+                        ],
+                        wire=True,
+                    )
+        except Exception:
+            log.exception("native redis decide failed")
+            results = [None] * len(batches)
+        for (keys, _mb, _cp, _pd, _qt, gen, fd), res in zip(
+            batches, results
+        ):
+            self._respond_one(keys, gen, fd, res)
+        self._maybe_sweep(now_ns, sum(len(b[0]) for b in batches))
+
+    def _respond_one(self, keys, cookie_gen, cookie_fd, res) -> None:
+        n = len(keys)
+        results = np.zeros(5 * n, np.int64)
+        if res is None:
+            status = np.full(n, STATUS_INTERNAL, np.uint8)
+        else:
             status = np.ascontiguousarray(res.status, np.uint8)
             out = results.reshape(n, 5)
             out[:, 0] = res.allowed
@@ -192,14 +239,13 @@ class NativeRedisTransport:
             out[:, 2] = res.remaining
             out[:, 3] = res.reset_after_s
             out[:, 4] = res.retry_after_s
-        except Exception:
-            log.exception("native redis decide failed")
-            status = np.full(n, STATUS_INTERNAL, np.uint8)
         self._lib.ws_respond(
             self._h,
             n,
-            self._cookie_gen.ctypes.data_as(ctypes.c_void_p),
-            self._cookie_fd.ctypes.data_as(ctypes.c_void_p),
+            np.ascontiguousarray(cookie_gen).ctypes.data_as(
+                ctypes.c_void_p
+            ),
+            np.ascontiguousarray(cookie_fd).ctypes.data_as(ctypes.c_void_p),
             results.ctypes.data_as(ctypes.c_void_p),
             status.ctypes.data_as(ctypes.c_void_p),
         )
@@ -224,7 +270,6 @@ class NativeRedisTransport:
                 denied_keys=denied_keys,
                 batch=n,
             )
-        self._maybe_sweep(now_ns, n)
 
     def _push_metrics(self) -> None:
         """GET /metrics is served from this snapshot (HTTP protocol; the

@@ -262,6 +262,64 @@ def derive_params(max_burst, count_per_period, period):
     return emission, tolerance, invalid
 
 
+class _ReadyLaunch:
+    """dispatch_many handle whose results are already on the host (empty
+    windows and the sequential multi-round fallback)."""
+
+    def __init__(self, results: list) -> None:
+        self._results = results
+
+    def fetch(self) -> list:
+        return self._results
+
+
+class _PendingLaunch:
+    """An in-flight device launch; `.fetch()` blocks on the device output
+    and distributes it into per-batch results.  Created by dispatch_many —
+    the device is already executing (or queued behind the table-state
+    dependency chain) by the time the caller holds this."""
+
+    def __init__(self, out_dev, prepared, valid_s, wire) -> None:
+        self._out_dev = out_dev
+        self._prepared = prepared
+        self._valid_s = valid_s
+        self._wire = wire
+
+    def fetch(self) -> list:
+        out = np.asarray(self._out_dev)
+        wire = self._wire
+        results = []
+        for j, (n, slots, rank, is_last, emission, tolerance, quantity,
+                valid, now_ns, max_burst, status) in enumerate(
+            self._prepared
+        ):
+            o = out[j, :, :n]
+            mask = self._valid_s[j, :n]
+            fields = dict(
+                allowed=(o[0] != 0) & mask,
+                limit=np.where(valid, max_burst, 0),
+                remaining=np.where(mask, o[1], 0),
+                status=status,
+            )
+            if wire:
+                results.append(
+                    WireBatchResult(
+                        reset_after_s=np.where(mask, o[2], 0),
+                        retry_after_s=np.where(mask, o[3], 0),
+                        **fields,
+                    )
+                )
+            else:
+                results.append(
+                    BatchResult(
+                        reset_after_ns=np.where(mask, o[2], 0),
+                        retry_after_ns=np.where(mask, o[3], 0),
+                        **fields,
+                    )
+                )
+        return results
+
+
 class TpuRateLimiter(ScalarCompatMixin):
     """Batched GCRA over a device bucket table + host keymap."""
 
@@ -447,10 +505,22 @@ class TpuRateLimiter(ScalarCompatMixin):
         rounds > 0) fall back to the per-batch path, preserving exact
         ordering; that case is rare in serving traffic.
         """
+        return self.dispatch_many(batches, wire=wire).fetch()
+
+    def dispatch_many(self, batches, wire: bool = False):
+        """The dispatch half of rate_limit_many: host-prepare the window,
+        launch it on the device, and return a handle whose `.fetch()`
+        blocks for the results.
+
+        Device dispatch is asynchronous, so the caller can assemble and
+        dispatch window N+1 while the device executes window N and only
+        then fetch N's results — the double-buffering that hides the fixed
+        per-launch round-trip cost of the serving tunnel (the engine's
+        flush loop does exactly this).  Launches are sequenced by the
+        donated table state, so results are identical to sequential calls.
+        """
         if not batches:
-            return []
-        if len(batches) == 1:
-            return [self.rate_limit_batch(*batches[0], wire=wire)]
+            return _ReadyLaunch([])
 
         prepared = []
         width = self.MIN_PAD
@@ -463,8 +533,11 @@ class TpuRateLimiter(ScalarCompatMixin):
                 keys, max_burst, count_per_period, period, quantity, now_ns
             )
             if rounds.any():
-                return sequential_fallback(
-                    batches, self.rate_limit_batch, self._error_result, wire
+                return _ReadyLaunch(
+                    sequential_fallback(
+                        batches, self.rate_limit_batch,
+                        self._error_result, wire,
+                    )
                 )
             any_degen = any_degen or has_degenerate(
                 valid, emission, tolerance, quantity
@@ -499,41 +572,11 @@ class TpuRateLimiter(ScalarCompatMixin):
             valid_s[j, :n] = valid
             now_s[j] = now_ns
 
-        out = np.asarray(
-            self.table.check_many(
-                slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s,
-                with_degen=not wire or any_degen, compact=wire,
-            )
+        out_dev = self.table.check_many(
+            slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s,
+            with_degen=not wire or any_degen, compact=wire,
         )
-
-        results = []
-        for j, (n, slots, rank, is_last, emission, tolerance, quantity,
-                valid, now_ns, max_burst, status) in enumerate(prepared):
-            o = out[j, :, :n]
-            mask = valid_s[j, :n]
-            fields = dict(
-                allowed=(o[0] != 0) & mask,
-                limit=np.where(valid, max_burst, 0),
-                remaining=np.where(mask, o[1], 0),
-                status=status,
-            )
-            if wire:
-                results.append(
-                    WireBatchResult(
-                        reset_after_s=np.where(mask, o[2], 0),
-                        retry_after_s=np.where(mask, o[3], 0),
-                        **fields,
-                    )
-                )
-            else:
-                results.append(
-                    BatchResult(
-                        reset_after_ns=np.where(mask, o[2], 0),
-                        retry_after_ns=np.where(mask, o[3], 0),
-                        **fields,
-                    )
-                )
-        return results
+        return _PendingLaunch(out_dev, prepared, valid_s, wire)
 
     # ------------------------------------------------------------------ #
 
