@@ -130,7 +130,67 @@ def main() -> int:
             "batch": B,
             "iters": iters,
         }))
+
+    # Concurrent-contention group: 1/2/4/8 clients hammering the engine
+    # (store_performance.rs:87-115 sweeps tokio threads the same way).
+    # Measures coalescing: throughput plus requests-per-launch.
+    bench_contention(B, max(iters * B // 8, 2000))
     return 0
+
+
+def bench_contention(batch_size: int, total_requests: int) -> None:
+    """N concurrent clients issue single requests through the batching
+    engine; the engine coalesces them into device launches.  Reports
+    decisions/s and the achieved requests-per-launch (coalescing
+    efficiency) per client count."""
+    import asyncio
+
+    from throttlecrab_tpu.server.engine import BatchingEngine
+    from throttlecrab_tpu.server.metrics import Metrics
+    from throttlecrab_tpu.server.types import ThrottleRequest
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    for n_clients in (1, 2, 4, 8):
+        limiter = TpuRateLimiter(capacity=1 << 16, keymap="auto")
+        metrics = Metrics.builder().max_denied_keys(0).build()
+        engine = BatchingEngine(
+            limiter,
+            batch_size=batch_size,
+            max_linger_us=200,
+            metrics=metrics,
+        )
+        per_client = total_requests // n_clients
+
+        async def run() -> float:
+            # Warm the compile outside the timed window.
+            await engine.throttle(ThrottleRequest("warm", 10, 100, 60, 1))
+
+            async def client(c: int) -> None:
+                for i in range(per_client):
+                    await engine.throttle(
+                        ThrottleRequest(
+                            f"c{c}:k{i % 512}", 1 << 30, 1 << 30, 3600, 1
+                        )
+                    )
+
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(client(c) for c in range(n_clients))
+            )
+            return time.perf_counter() - t0
+
+        dt = asyncio.run(run())
+        decided = per_client * n_clients
+        launches = max(metrics.device_launches - 1, 1)  # minus warmup
+        print(json.dumps({
+            "scenario": f"contention_{n_clients}_clients",
+            "decisions_per_sec": round(decided / dt),
+            "requests_per_launch": round(
+                (metrics.batched_requests - 1) / launches, 1
+            ),
+            "clients": n_clients,
+            "requests": decided,
+        }))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Build (and optionally tag) the throttlecrab-tpu server image.
+#
+# Usage: scripts/docker-build.sh [TAG]
+#   TAG defaults to "dev".  The image is always also tagged "latest".
+#
+# Mirrors the reference's scripts/docker-build.sh role: one obvious
+# entry point for local builds and for the Release workflow.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+TAG="${1:-dev}"
+IMAGE="${THROTTLECRAB_IMAGE:-throttlecrab-tpu}"
+
+docker build -t "${IMAGE}:${TAG}" -t "${IMAGE}:latest" .
+
+echo "built ${IMAGE}:${TAG}"
+echo "smoke test:"
+echo "  docker run --rm -p 8080:8080 -e THROTTLECRAB_PLATFORM=cpu ${IMAGE}:${TAG}"
+echo "  curl -X POST localhost:8080/throttle -H 'Content-Type: application/json' \\"
+echo "       -d '{\"key\":\"smoke\",\"max_burst\":3,\"count_per_period\":10,\"period\":60}'"

@@ -149,3 +149,141 @@ def test_snapshot_survives_lone_surrogate_key(tmp_path):
     # Identity preserved: the same weird str hits the restored bucket.
     _, r = lim2.rate_limit(weird, 3, 10, 3600, 1, T0 + NS)
     assert r.remaining == 1  # 3 - 1 (pre-snapshot) - 1 (now)
+
+
+# -------------------------------------------------- sharded / cluster #
+
+
+def _exercise(lim):
+    """Burn state into a limiter: one exhausted key + 50 touched keys."""
+    for _ in range(3):
+        lim.rate_limit("hot", 3, 10, 3600, 1, T0)
+    lim.rate_limit_batch(
+        [f"k{i}" for i in range(50)], 5, 10, 3600, 1, T0
+    )
+
+
+def _check_continuity(lim):
+    allowed, _ = lim.rate_limit("hot", 3, 10, 3600, 1, T0 + NS)
+    assert not allowed  # still exhausted after restore
+    allowed, r = lim.rate_limit("k0", 5, 10, 3600, 1, T0 + NS)
+    assert allowed and r.remaining == 3
+
+
+def test_sharded_snapshot_round_trip(tmp_path):
+    from throttlecrab_tpu.parallel.sharded import (
+        ShardedTpuRateLimiter,
+        make_mesh,
+    )
+
+    path = tmp_path / "snap.npz"
+    lim = ShardedTpuRateLimiter(
+        capacity_per_shard=256, mesh=make_mesh(4)
+    )
+    _exercise(lim)
+    assert save_snapshot(lim, path) == 51
+
+    lim2 = ShardedTpuRateLimiter(
+        capacity_per_shard=256, mesh=make_mesh(4)
+    )
+    assert load_snapshot(lim2, path, now_ns=T0 + NS) == 51
+    _check_continuity(lim2)
+
+
+def test_sharded_snapshot_restores_across_shard_counts(tmp_path):
+    """A 8-shard snapshot restores onto 2 shards (and the reverse):
+    shard topology is not part of the snapshot contract — keys re-route
+    through the target's own hash."""
+    from throttlecrab_tpu.parallel.sharded import (
+        ShardedTpuRateLimiter,
+        make_mesh,
+    )
+
+    path = tmp_path / "snap.npz"
+    lim = ShardedTpuRateLimiter(
+        capacity_per_shard=256, mesh=make_mesh(8)
+    )
+    _exercise(lim)
+    save_snapshot(lim, path)
+
+    lim2 = ShardedTpuRateLimiter(
+        capacity_per_shard=256, mesh=make_mesh(2)
+    )
+    assert load_snapshot(lim2, path, now_ns=T0 + NS) == 51
+    _check_continuity(lim2)
+
+
+def test_sharded_snapshot_restores_to_single_device(tmp_path):
+    from throttlecrab_tpu.parallel.sharded import (
+        ShardedTpuRateLimiter,
+        make_mesh,
+    )
+
+    path = tmp_path / "snap.npz"
+    lim = ShardedTpuRateLimiter(
+        capacity_per_shard=256, mesh=make_mesh(4)
+    )
+    _exercise(lim)
+    save_snapshot(lim, path)
+
+    lim2 = TpuRateLimiter(capacity=1024)
+    assert load_snapshot(lim2, path, now_ns=T0 + NS) == 51
+    _check_continuity(lim2)
+
+
+def test_single_device_snapshot_restores_to_sharded(tmp_path):
+    from throttlecrab_tpu.parallel.sharded import (
+        ShardedTpuRateLimiter,
+        make_mesh,
+    )
+
+    path = tmp_path / "snap.npz"
+    lim = TpuRateLimiter(capacity=256)
+    _exercise(lim)
+    save_snapshot(lim, path)
+
+    lim2 = ShardedTpuRateLimiter(
+        capacity_per_shard=256, mesh=make_mesh(4)
+    )
+    assert load_snapshot(lim2, path, now_ns=T0 + NS) == 51
+    _check_continuity(lim2)
+
+
+def test_sharded_restore_drops_expired(tmp_path):
+    from throttlecrab_tpu.parallel.sharded import (
+        ShardedTpuRateLimiter,
+        make_mesh,
+    )
+
+    path = tmp_path / "snap.npz"
+    lim = ShardedTpuRateLimiter(
+        capacity_per_shard=64, mesh=make_mesh(2)
+    )
+    lim.rate_limit("short", 2, 10, 1, 1, T0)
+    lim.rate_limit("long", 2, 10, 3600, 1, T0)
+    save_snapshot(lim, path)
+
+    lim2 = ShardedTpuRateLimiter(
+        capacity_per_shard=64, mesh=make_mesh(2)
+    )
+    assert load_snapshot(lim2, path, now_ns=T0 + 100 * NS) == 1
+    assert len(lim2) == 1
+
+
+def test_cluster_snapshot_delegates_to_local(tmp_path):
+    """ClusterLimiter snapshots its local node's state (one file per
+    node — each node owns its key range)."""
+    from throttlecrab_tpu.parallel.cluster import ClusterLimiter
+
+    path = tmp_path / "snap.npz"
+    cl = ClusterLimiter(
+        TpuRateLimiter(capacity=256), ["127.0.0.1:1"], 0
+    )
+    _exercise(cl)
+    assert save_snapshot(cl, path) == 51
+
+    cl2 = ClusterLimiter(
+        TpuRateLimiter(capacity=256), ["127.0.0.1:1"], 0
+    )
+    assert load_snapshot(cl2, path, now_ns=T0 + NS) == 51
+    _check_continuity(cl2)

@@ -181,8 +181,10 @@ class PeerConnection:
     every batch for IO_TIMEOUT_S=30 s):
 
     - `io_timeout_s` is a serving-grade per-operation deadline (default
-      250 ms): an accepted-but-silent peer fails its requests within the
-      deadline instead of wedging the pipeline.
+      1 s — it must cover the owner's full remote decision including a
+      device launch, measured at ~270 ms through the TPU tunnel,
+      docs/tpu-launch-profile.md): an accepted-but-silent peer fails its
+      requests within the deadline instead of wedging the pipeline.
     - after a failure, reconnect attempts back off exponentially
       (BACKOFF_MIN_S → BACKOFF_MAX_S); attempts inside the backoff window
       raise PeerUnavailable immediately, without touching the network.
@@ -191,8 +193,8 @@ class PeerConnection:
       instantly until one probe attempt is allowed through.
     """
 
-    CONNECT_TIMEOUT_S = 5.0
-    IO_TIMEOUT_S = 0.25
+    CONNECT_TIMEOUT_S = 1.0
+    IO_TIMEOUT_S = 1.0
     BACKOFF_MIN_S = 0.05
     BACKOFF_MAX_S = 2.0
     BREAKER_FAILURES = 3

@@ -121,7 +121,7 @@ async def run_server(config: Config) -> None:
             io_timeout_s=config.cluster_timeout_ms / 1000.0,
             breaker_failures=config.cluster_breaker_failures,
             breaker_cooldown_s=config.cluster_breaker_cooldown_ms / 1000.0,
-            connect_timeout_s=config.cluster_timeout_ms / 1000.0,
+            connect_timeout_s=config.cluster_connect_timeout_ms / 1000.0,
         )
         metrics.set_cluster_stats_provider(limiter.peer_stats)
     engine = BatchingEngine(

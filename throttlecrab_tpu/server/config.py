@@ -79,8 +79,12 @@ _SPEC = [
      "This node's position in --cluster-nodes"),
     ("cluster_bind_host", "THROTTLECRAB_CLUSTER_BIND_HOST", "0.0.0.0", str,
      "Bind host for the cluster RPC listener"),
-    ("cluster_timeout_ms", "THROTTLECRAB_CLUSTER_TIMEOUT_MS", 250, int,
-     "Per-peer forward deadline in milliseconds"),
+    ("cluster_timeout_ms", "THROTTLECRAB_CLUSTER_TIMEOUT_MS", 1000, int,
+     "Per-peer forward deadline in milliseconds (must cover the owner's "
+     "remote decision incl. one device launch)"),
+    ("cluster_connect_timeout_ms",
+     "THROTTLECRAB_CLUSTER_CONNECT_TIMEOUT_MS", 1000, int,
+     "Per-peer TCP connect deadline in milliseconds"),
     ("cluster_breaker_failures", "THROTTLECRAB_CLUSTER_BREAKER_FAILURES",
      3, int, "Consecutive peer failures that open the circuit breaker"),
     ("cluster_breaker_cooldown_ms",
@@ -121,7 +125,8 @@ class Config:
     cluster_nodes: str = ""
     cluster_index: int = 0
     cluster_bind_host: str = "0.0.0.0"
-    cluster_timeout_ms: int = 250
+    cluster_timeout_ms: int = 1000
+    cluster_connect_timeout_ms: int = 1000
     cluster_breaker_failures: int = 3
     cluster_breaker_cooldown_ms: int = 1000
 

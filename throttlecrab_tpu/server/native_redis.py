@@ -220,13 +220,44 @@ class NativeRedisTransport:
         except Exception:
             log.exception("native redis decide failed")
             results = [None] * len(batches)
+        # Metrics: ONE aggregated record for the whole window — it was
+        # one device launch (record_batch bumps device_launches, so
+        # per-sub-batch calls would overcount launches by up to
+        # max_scan_depth and wreck the coalescing ratio).
+        tot_allowed = tot_denied = tot_errors = 0
+        denied_keys: list = []
+        track_denied = (
+            self.metrics is not None
+            and self.metrics.top_denied is not None
+        )
+        any_launch = False
         for (keys, _mb, _cp, _pd, _qt, gen, fd), res in zip(
             batches, results
         ):
-            self._respond_one(keys, gen, fd, res)
+            n_a, n_d, n_e, dk = self._respond_one(
+                keys, gen, fd, res, track_denied
+            )
+            tot_allowed += n_a
+            tot_denied += n_d
+            tot_errors += n_e
+            denied_keys.extend(dk)
+            any_launch = any_launch or res is not None
+        if self.metrics is not None and (
+            any_launch or tot_errors
+        ):
+            self.metrics.record_batch(
+                self.name,
+                n_allowed=tot_allowed,
+                n_denied=tot_denied,
+                n_errors=tot_errors,
+                denied_keys=denied_keys,
+                batch=tot_allowed + tot_denied + tot_errors,
+            )
         self._maybe_sweep(now_ns, sum(len(b[0]) for b in batches))
 
-    def _respond_one(self, keys, cookie_gen, cookie_fd, res) -> None:
+    def _respond_one(self, keys, cookie_gen, cookie_fd, res, track_denied):
+        """Serialize one sub-batch's replies; returns (n_allowed,
+        n_denied, n_errors, denied_keys) for the caller's aggregate."""
         n = len(keys)
         results = np.zeros(5 * n, np.int64)
         if res is None:
@@ -249,27 +280,24 @@ class NativeRedisTransport:
             results.ctypes.data_as(ctypes.c_void_p),
             status.ctypes.data_as(ctypes.c_void_p),
         )
-        if self.metrics is not None:
-            ok = status == 0
-            allowed_mask = results.reshape(n, 5)[:, 0] != 0
-            if self.metrics.top_denied is not None:
-                denied_keys = [
-                    k.decode("utf-8", "replace") if isinstance(k, bytes)
-                    else k
-                    for k in (
-                        keys[i] for i in np.flatnonzero(~allowed_mask & ok)
-                    )
-                ]
-            else:
-                denied_keys = ()
-            self.metrics.record_batch(
-                self.name,
-                n_allowed=int((allowed_mask & ok).sum()),
-                n_denied=int((~allowed_mask & ok).sum()),
-                n_errors=int((~ok).sum()),
-                denied_keys=denied_keys,
-                batch=n,
-            )
+        ok = status == 0
+        allowed_mask = results.reshape(n, 5)[:, 0] != 0
+        denied_keys = (
+            [
+                k.decode("utf-8", "replace") if isinstance(k, bytes) else k
+                for k in (
+                    keys[i] for i in np.flatnonzero(~allowed_mask & ok)
+                )
+            ]
+            if track_denied
+            else []
+        )
+        return (
+            int((allowed_mask & ok).sum()),
+            int((~allowed_mask & ok).sum()),
+            int((~ok).sum()),
+            denied_keys,
+        )
 
     def _push_metrics(self) -> None:
         """GET /metrics is served from this snapshot (HTTP protocol; the

@@ -21,26 +21,18 @@ from typing import Union
 
 import numpy as np
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2 adds the per-key `shard` column (v1 loads fine)
+
+_U32 = (1 << 32) - 1
 
 
-def save_snapshot(limiter, path: Union[str, Path]) -> int:
-    """Write the limiter's live state to `path` (.npz); returns #keys saved.
-
-    Works for TpuRateLimiter (single device).  Only live slots are saved:
-    tat/expiry columns plus each slot's key bytes.
-    """
-    from .limiter import limiter_uses_bytes_keys
-
-    path = Path(path)
-    tat = np.asarray(limiter.table.tat)
-    expiry = np.asarray(limiter.table.expiry)
-
+def _encode_keys(pairs):
+    """[(key, slot)] → (slots, key bytes + per-key codec metadata)."""
     slots = []
     keys = []
     key_is_bytes = []
     key_codec = []  # 0 = surrogateescape, 1 = surrogatepass
-    for key, slot in limiter.keymap.items():
+    for key, slot in pairs:
         slots.append(slot)
         is_b = isinstance(key, (bytes, bytearray))
         key_is_bytes.append(is_b)
@@ -58,7 +50,64 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
             except UnicodeEncodeError:
                 keys.append(str(key).encode("utf-8", "surrogatepass"))
                 key_codec.append(1)
-    slots = np.asarray(slots, np.int64)
+    return slots, keys, key_is_bytes, key_codec
+
+
+def save_snapshot(limiter, path: Union[str, Path]) -> int:
+    """Write the limiter's live state to `path` (.npz); returns #keys saved.
+
+    Works for TpuRateLimiter (single device), ShardedTpuRateLimiter
+    (per-shard columns in one npz), and ClusterLimiter (delegates to the
+    node's local limiter — each cluster node owns its key range, so a
+    cluster snapshot is one file per node, like one RDB per Redis shard).
+    Only live slots are saved: tat/expiry columns plus each slot's key
+    bytes.
+    """
+    from .limiter import limiter_uses_bytes_keys
+
+    local = getattr(limiter, "local", None)
+    if local is not None:  # ClusterLimiter
+        return save_snapshot(local, path)
+
+    path = Path(path)
+    if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
+        # [D, rows, 4] packed i32 — one gather off the mesh.
+        state = np.asarray(limiter.table.state)
+        per_shard = [
+            _encode_keys(km.items()) for km in limiter.keymaps
+        ]
+        slots = np.asarray(
+            [s for p in per_shard for s in p[0]], np.int64
+        )
+        shard = np.asarray(
+            [d for d, p in enumerate(per_shard) for _ in p[0]], np.int32
+        )
+        keys = [k for p in per_shard for k in p[1]]
+        key_is_bytes = [b for p in per_shard for b in p[2]]
+        key_codec = [c for p in per_shard for c in p[3]]
+        rows = state[shard, slots] if len(slots) else np.zeros(
+            (0, 4), np.int32
+        )
+        tat = (rows[:, 1].astype(np.int64) << 32) | (
+            rows[:, 0].astype(np.int64) & _U32
+        )
+        expiry = (rows[:, 3].astype(np.int64) << 32) | (
+            rows[:, 2].astype(np.int64) & _U32
+        )
+        capacity = limiter.table.capacity  # per shard
+    else:
+        tat_col = np.asarray(limiter.table.tat)
+        expiry_col = np.asarray(limiter.table.expiry)
+        slots, keys, key_is_bytes, key_codec = _encode_keys(
+            limiter.keymap.items()
+        )
+        slots = np.asarray(slots, np.int64)
+        shard = np.zeros(len(slots), np.int32)
+        tat = tat_col[slots] if len(slots) else np.zeros(0, np.int64)
+        expiry = (
+            expiry_col[slots] if len(slots) else np.zeros(0, np.int64)
+        )
+        capacity = limiter.table.capacity
 
     # Length-prefixed layout (offsets[n+1] + blob): binary-safe for keys
     # containing any byte, including NUL.
@@ -69,10 +118,12 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
     np.savez_compressed(
         path,
         version=np.int64(FORMAT_VERSION),
-        capacity=np.int64(limiter.table.capacity),
+        capacity=np.int64(capacity),
         slots=slots,
-        tat=tat[slots] if len(slots) else np.zeros(0, np.int64),
-        expiry=expiry[slots] if len(slots) else np.zeros(0, np.int64),
+        shard=shard,
+        n_shards=np.int64(getattr(limiter, "n_shards", 1)),
+        tat=tat,
+        expiry=expiry,
         key_offsets=offsets,
         key_blob=np.frombuffer(key_blob, np.uint8),
         key_is_bytes=np.asarray(key_is_bytes, np.uint8),
@@ -93,15 +144,25 @@ def load_snapshot(limiter, path: Union[str, Path], now_ns: int) -> int:
 
     `now_ns` gates restoration: entries already expired are skipped (the
     TTL contract holds across restarts).  The limiter must be empty.
+
+    Shard topology is NOT part of the contract: a snapshot taken on D
+    shards restores onto any shard count (including a single-device
+    limiter, or vice versa) — keys are re-routed by the target's own
+    key→shard hash at restore time.  ClusterLimiter targets restore into
+    their local node (pair each node with its own snapshot file).
     """
     from .limiter import limiter_uses_bytes_keys
+
+    local = getattr(limiter, "local", None)
+    if local is not None:  # ClusterLimiter
+        return load_snapshot(local, path, now_ns)
 
     if len(limiter) != 0:
         raise ValueError("restore requires an empty limiter")
     path = Path(path)
     with np.load(path) as data:
         version = int(data["version"])
-        if version != FORMAT_VERSION:
+        if version not in (1, FORMAT_VERSION):
             raise ValueError(f"unsupported snapshot version {version}")
         tat = data["tat"]
         expiry = data["expiry"]
@@ -155,15 +216,74 @@ def load_snapshot(limiter, path: Union[str, Path], now_ns: int) -> int:
         restored += 1
 
     if restored:
-        _bulk_insert(limiter, batch_keys, batch_tat, batch_exp)
+        restored = _bulk_insert(limiter, batch_keys, batch_tat, batch_exp)
     return restored
 
 
-def _bulk_insert(limiter, keys, tats, expiries) -> None:
-    """Allocate slots for `keys` and write their state rows directly."""
+def _bulk_insert(limiter, keys, tats, expiries) -> int:
+    """Allocate slots for `keys` and write their state rows directly;
+    returns the number actually inserted.
+
+    Sharded targets re-route every key through the target's own
+    key→shard hash (the snapshot's shard column is advisory only), so a
+    D-shard snapshot restores onto any shard count."""
     import jax.numpy as jnp
 
     from .kernel import pack_state
+
+    if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
+        import jax
+
+        from ..parallel.sharded import shard_of_key
+
+        D = limiter.n_shards
+        by_shard: list = [[] for _ in range(D)]
+        skipped = 0
+        for i, k in enumerate(keys):
+            if isinstance(k, bytes):
+                kb = k
+            else:
+                try:
+                    kb = str(k).encode()
+                except UnicodeEncodeError:
+                    # A lone-surrogate str key cannot be routed (the
+                    # sharded limiter's own decide path strict-encodes
+                    # keys the same way, so it could never serve this
+                    # key anyway).  Skip it — one odd key must not lose
+                    # the whole snapshot.
+                    skipped += 1
+                    continue
+            by_shard[shard_of_key(kb, D)].append(i)
+        # np.array (not asarray): jax arrays surface as read-only views.
+        state = np.array(limiter.table.state)  # [D, rows, 4]
+        for d, ix in enumerate(by_shard):
+            if not ix:
+                continue
+            km = limiter.keymaps[d]
+            if getattr(km, "BYTES_KEYS", False):
+                key_src = [
+                    keys[i]
+                    if isinstance(keys[i], bytes)
+                    else keys[i].encode("utf-8", "surrogateescape")
+                    for i in ix
+                ]
+            else:
+                key_src = [keys[i] for i in ix]
+            valid = np.ones(len(ix), bool)
+            slots, _, _, n_full = km.resolve(key_src, valid)
+            if n_full:
+                raise ValueError("snapshot exceeds limiter capacity")
+            rows = np.asarray(
+                pack_state(
+                    jnp.asarray([tats[i] for i in ix], jnp.int64),
+                    jnp.asarray([expiries[i] for i in ix], jnp.int64),
+                )
+            )
+            state[d, slots] = rows
+        limiter.table.state = jax.device_put(
+            state, limiter.table.sharding
+        )
+        return len(keys) - skipped
 
     if getattr(limiter.keymap, "BYTES_KEYS", False):
         key_src = [
@@ -184,3 +304,4 @@ def _bulk_insert(limiter, keys, tats, expiries) -> None:
     limiter.table.state = limiter.table.state.at[
         jnp.asarray(slots, jnp.int32)
     ].set(rows)
+    return len(keys)
