@@ -116,3 +116,29 @@ def test_engine_backlog_drains_through_scan_path():
     # 300 requests over 40 keys = 7-8 per key < burst 50: all allowed.
     assert all(r.allowed for r in results)
     assert all(r.limit == 50 for r in results)
+
+
+def test_engine_double_buffers_sharded_limiter():
+    """The flush loop's dispatch/fetch split must work against the
+    sharded limiter too (dispatch_many on the mesh): exactness across
+    overlapped windows on the 8-device CPU mesh."""
+    from throttlecrab_tpu.parallel.sharded import ShardedTpuRateLimiter
+
+    async def main():
+        limiter = ShardedTpuRateLimiter(capacity_per_shard=512)
+        engine = BatchingEngine(
+            limiter, batch_size=16, max_linger_us=500,
+            now_fn=lambda: T0, max_scan_depth=2,
+        )
+        results = await asyncio.gather(
+            *[
+                engine.throttle(
+                    ThrottleRequest("sharded:hot", 24, 100, 3600, 1)
+                )
+                for _ in range(64)
+            ]
+        )
+        return results
+
+    results = asyncio.run(main())
+    assert sum(r.allowed for r in results) == 24

@@ -152,9 +152,10 @@ def test_sharded_many_cross_batch_state_carries():
 
 
 def test_engine_backlog_drains_through_sharded_scan(monkeypatch):
-    """The serving engine's backlog path must take ONE rate_limit_many
-    launch on the mesh when shards > 1 — the case that used to silently
-    degrade to one-batch-per-launch."""
+    """The serving engine's backlog path must take ONE multi-batch mesh
+    launch when shards > 1 — the case that used to silently degrade to
+    one-batch-per-launch.  The engine enters through dispatch_many (the
+    double-buffered flush loop)."""
     import asyncio
 
     from throttlecrab_tpu.server.engine import BatchingEngine
@@ -164,13 +165,13 @@ def test_engine_backlog_drains_through_sharded_scan(monkeypatch):
         capacity_per_shard=1024, mesh=make_mesh(4)
     )
     many_calls = []
-    orig = limiter.rate_limit_many
+    orig = limiter.dispatch_many
 
     def spy(batches, **kw):
         many_calls.append(len(batches))
         return orig(batches, **kw)
 
-    monkeypatch.setattr(limiter, "rate_limit_many", spy)
+    monkeypatch.setattr(limiter, "dispatch_many", spy)
 
     async def main():
         engine = BatchingEngine(

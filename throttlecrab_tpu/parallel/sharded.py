@@ -21,6 +21,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import threading
 import zlib
 from typing import Optional, Sequence
 
@@ -40,6 +41,7 @@ from ..tpu.kernel import EMPTY_EXPIRY, _gcra_body, pack_state, unpack_state
 from ..tpu.keymap import PyKeyMap
 from ..tpu.limiter import (
     BatchResult,
+    _ReadyLaunch,
     ScalarCompatMixin,
     TpuRateLimiter,
     WireBatchResult,
@@ -328,6 +330,48 @@ class ShardedBucketTable:
         return unpack_state(self.state)[1][:, : self.capacity]
 
 
+class _PendingShardedLaunch:
+    """An in-flight mesh launch; .fetch() blocks on the stacked output,
+    accumulates the psum'd global counters, and distributes per-batch
+    results."""
+
+    def __init__(self, limiter, out_dev, counters, prepared, wire) -> None:
+        self._limiter = limiter
+        self._out_dev = out_dev
+        self._counters = counters
+        self._prepared = prepared
+        self._wire = wire
+
+    def fetch(self) -> list:
+        out = np.asarray(self._out_dev)
+        c = np.asarray(self._counters)
+        self._limiter._bump_counters(int(c[0]), int(c[1]))
+        results = []
+        for j, prep in enumerate(self._prepared):
+            (n, per_shard, slots, rank, is_last, em, tol, q, vmask,
+             rounds, max_burst, status, valid, emission, tolerance,
+             quantity) = prep
+            allowed = np.zeros(n, bool)
+            remaining = np.zeros(n, np.int64)
+            reset_after = np.zeros(n, np.int64)
+            retry_after = np.zeros(n, np.int64)
+            for d, ix in enumerate(per_shard):
+                m = len(ix)
+                if m == 0:
+                    continue
+                allowed[ix] = out[d, j, 0, :m] != 0
+                remaining[ix] = out[d, j, 1, :m]
+                reset_after[ix] = out[d, j, 2, :m]
+                retry_after[ix] = out[d, j, 3, :m]
+            results.append(
+                self._limiter._make_result(
+                    valid, max_burst, status, allowed, remaining,
+                    reset_after, retry_after, self._wire,
+                )
+            )
+        return results
+
+
 class ShardedTpuRateLimiter(ScalarCompatMixin):
     """Batched GCRA with the table sharded over a device mesh.
 
@@ -369,12 +413,22 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
             getattr(self.keymaps[0], "BYTES_KEYS", False)
         )
         self.auto_grow = auto_grow
-        # psum-reduced global totals, updated per batch.
+        # psum-reduced global totals, updated per batch.  Fetches can run
+        # on an engine executor thread concurrently with a native
+        # transport's decide thread, so accumulation takes its own lock.
         self.total_allowed = 0
         self.total_denied = 0
+        self._counter_lock = threading.Lock()
 
     def __len__(self) -> int:
         return sum(len(km) for km in self.keymaps)
+
+    def _bump_counters(self, allowed: int, denied: int) -> None:
+        """Accumulate the psum'd global counters; a launch fetch (engine
+        executor thread) can race a native transport's decide thread."""
+        with self._counter_lock:
+            self.total_allowed += allowed
+            self.total_denied += denied
 
     @property
     def total_capacity(self) -> int:
@@ -524,8 +578,7 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
             )
             out = np.asarray(out_dev)
             c = np.asarray(counters)
-            self.total_allowed += int(c[0])
-            self.total_denied += int(c[1])
+            self._bump_counters(int(c[0]), int(c[1]))
             for d, ix in enumerate(per_shard):
                 m = len(ix)
                 if m == 0:
@@ -554,10 +607,20 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
         parameters mid-batch fall back to the sequential per-batch path
         (rare; exactness beats speed).
         """
+        return self.dispatch_many(batches, wire=wire).fetch()
+
+    def dispatch_many(self, batches, wire: bool = False):
+        """The dispatch half of rate_limit_many (same split as
+        TpuRateLimiter.dispatch_many): host-prepare + mesh-launch the
+        window, return a handle whose .fetch() blocks for results — so
+        the engine's flush loop can assemble window N+1 while the mesh
+        executes window N."""
         if not batches:
-            return []
+            return _ReadyLaunch([])
         if len(batches) == 1:
-            return [self.rate_limit_batch(*batches[0], wire=wire)]
+            return _ReadyLaunch(
+                [self.rate_limit_batch(*batches[0], wire=wire)]
+            )
 
         prepared = []
         width = self.MIN_PAD
@@ -579,9 +642,11 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
         if fallback:
             # Re-deciding already-prepared batches is safe: prep only
             # resolves slots (idempotent), no device writes happened yet.
-            return sequential_fallback(
-                batches, self.rate_limit_batch,
-                TpuRateLimiter._error_result, wire,
+            return _ReadyLaunch(
+                sequential_fallback(
+                    batches, self.rate_limit_batch,
+                    TpuRateLimiter._error_result, wire,
+                )
             )
 
         D = self.n_shards
@@ -614,35 +679,9 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
             slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s,
             with_degen=not wire or any_degen, compact=wire,
         )
-        out = np.asarray(out_dev)
-        c = np.asarray(counters)
-        self.total_allowed += int(c[0])
-        self.total_denied += int(c[1])
-
-        results = []
-        for j, prep in enumerate(prepared):
-            (n, per_shard, slots, rank, is_last, em, tol, q, vmask,
-             rounds, max_burst, status, valid, emission, tolerance,
-             quantity) = prep
-            allowed = np.zeros(n, bool)
-            remaining = np.zeros(n, np.int64)
-            reset_after = np.zeros(n, np.int64)
-            retry_after = np.zeros(n, np.int64)
-            for d, ix in enumerate(per_shard):
-                m = len(ix)
-                if m == 0:
-                    continue
-                allowed[ix] = out[d, j, 0, :m] != 0
-                remaining[ix] = out[d, j, 1, :m]
-                reset_after[ix] = out[d, j, 2, :m]
-                retry_after[ix] = out[d, j, 3, :m]
-            results.append(
-                self._make_result(
-                    valid, max_burst, status, allowed, remaining,
-                    reset_after, retry_after, wire,
-                )
-            )
-        return results
+        return _PendingShardedLaunch(
+            self, out_dev, counters, prepared, wire
+        )
 
     # ------------------------------------------------------------------ #
 

@@ -572,8 +572,17 @@ class TpuRateLimiter(ScalarCompatMixin):
             valid_s[j, :n] = valid
             now_s[j] = now_ns
 
-        out_dev = self.table.check_many(
-            slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s,
+        # One fused host→device buffer for the whole window: the serving
+        # tunnel charges ~6 ms per transfer *call*, so eight per-array
+        # transfers per launch would cost more than the device work
+        # (docs/tpu-launch-profile.md).
+        from .kernel import pack_requests
+
+        packed = pack_requests(
+            slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s
+        )
+        out_dev = self.table.check_many_packed(
+            packed, now_s,
             with_degen=not wire or any_degen, compact=wire,
         )
         return _PendingLaunch(out_dev, prepared, valid_s, wire)
