@@ -44,10 +44,6 @@ struct Entry {
     int64_t key_off = -1;   // -1: bucket empty
     int32_t key_len = 0;
     int32_t slot = -1;
-    // Per-batch segment tracking.
-    uint64_t batch_stamp = 0;
-    int32_t batch_count = 0;
-    int32_t batch_last_pos = -1;
 };
 
 struct KeyMap {
@@ -62,6 +58,18 @@ struct KeyMap {
     // id→key registry for tk_assemble: key bytes appended in intern order.
     std::vector<char> id_arena;
     std::vector<int64_t> id_off;      // n_ids + 1 offsets into id_arena
+    // id→slot cache: after a key's first probe its slot is an O(1) array
+    // read (the equivalent of the reference holding a HashMap entry
+    // pointer).  slot_id is the reverse map so tk_free_slots can
+    // invalidate exactly the freed keys' cache lines.
+    std::vector<int32_t> id_slot;     // -1 = not cached
+    std::vector<int32_t> slot_id;     // -1 = slot not owned by an id
+    // Per-batch duplicate-segment tracking, indexed by slot (a slot
+    // uniquely identifies a key within a batch, and slot indexing works
+    // for both the probe path and the id-cache fast path).
+    std::vector<uint64_t> slot_stamp;
+    std::vector<int32_t> slot_count;
+    std::vector<int32_t> slot_last_pos;
 
     explicit KeyMap(int64_t cap) { init(cap); }
 
@@ -76,6 +84,10 @@ struct KeyMap {
         for (int64_t i = 0; i < cap; i++)
             free_slots[i] = static_cast<int32_t>(cap - 1 - i);
         slot_bucket.assign(cap, -1);
+        slot_id.assign(cap, -1);
+        slot_stamp.assign(cap, 0);
+        slot_count.assign(cap, 0);
+        slot_last_pos.assign(cap, -1);
         arena.reserve(cap * 16);
     }
 
@@ -98,15 +110,19 @@ struct KeyMap {
         for (int64_t i = new_cap - 1; i >= capacity; i--)
             free_slots.push_back(static_cast<int32_t>(i));
         slot_bucket.resize(new_cap, -1);
+        slot_id.resize(new_cap, -1);
+        slot_stamp.resize(new_cap, 0);
+        slot_count.resize(new_cap, 0);
+        slot_last_pos.resize(new_cap, -1);
         capacity = new_cap;
-        if (static_cast<uint64_t>(new_cap) * 2 > buckets.size())
-            rehash(buckets.size() * 2 >= static_cast<uint64_t>(new_cap) * 2
-                       ? buckets.size()
-                       : [&] {
-                             uint64_t n = buckets.size();
-                             while (n < static_cast<uint64_t>(new_cap) * 2) n <<= 1;
-                             return n;
-                         }());
+        // Keep nbuckets >= 2 * capacity (load factor <= 0.5): the probe
+        // loops rely on an empty bucket always existing — at load factor
+        // 1.0 a miss probe never terminates.
+        if (static_cast<uint64_t>(new_cap) * 2 > buckets.size()) {
+            uint64_t n = buckets.size();
+            while (n < static_cast<uint64_t>(new_cap) * 2) n <<= 1;
+            rehash(n);
+        }
     }
 };
 
@@ -177,15 +193,16 @@ int64_t tk_lookup_insert_batch(
             m->slot_bucket[slot] = static_cast<int64_t>(b);
             m->size++;
         }
-        out_slots[i] = e->slot;
-        if (e->batch_stamp == stamp) {
-            out_rank[i] = ++e->batch_count - 1;
-            out_is_last[e->batch_last_pos] = 0;
-            e->batch_last_pos = static_cast<int32_t>(i);
+        const int32_t slot = e->slot;
+        out_slots[i] = slot;
+        if (m->slot_stamp[slot] == stamp) {
+            out_rank[i] = ++m->slot_count[slot] - 1;
+            out_is_last[m->slot_last_pos[slot]] = 0;
+            m->slot_last_pos[slot] = static_cast<int32_t>(i);
         } else {
-            e->batch_stamp = stamp;
-            e->batch_count = 1;
-            e->batch_last_pos = static_cast<int32_t>(i);
+            m->slot_stamp[slot] = stamp;
+            m->slot_count[slot] = 1;
+            m->slot_last_pos[slot] = static_cast<int32_t>(i);
         }
     }
     return full;
@@ -219,6 +236,7 @@ int64_t tk_intern_keys(void* h, const char* keys, const int64_t* offsets,
         m->id_arena.insert(m->id_arena.end(), keys + offsets[i],
                            keys + offsets[i] + len);
         m->id_off.push_back(static_cast<int64_t>(m->id_arena.size()));
+        m->id_slot.push_back(-1);
     }
     return first;
 }
@@ -252,48 +270,61 @@ int64_t tk_assemble(void* h, const int32_t* ids, int64_t total, int64_t batch,
                 if (id >= n_ids) full++;  // un-interned id: surface it
                 continue;
             }
-            const char* key = m->id_arena.data() + m->id_off[id];
-            const int64_t len = m->id_off[id + 1] - m->id_off[id];
-            const uint64_t hash = fnv1a(key, len);
-            uint64_t b = hash & m->mask;
-            Entry* e;
-            for (;;) {
-                e = &m->buckets[b];
-                if (e->key_off < 0) break;
-                if (e->hash == hash && e->key_len == len &&
-                    memcmp(m->arena.data() + e->key_off, key, len) == 0)
-                    break;
-                b = (b + 1) & m->mask;
-            }
-            if (e->key_off < 0) {
-                if (m->free_slots.empty()) {
-                    w[0] = -1;
-                    for (int j = 1; j < PACK_W; j++) w[j] = 0;
-                    full++;
-                    continue;
+            int32_t slot = m->id_slot[id];
+            if (slot < 0) {
+                // Slow path: hash + probe (first touch after intern or
+                // after a sweep freed the slot), then cache.
+                const char* key = m->id_arena.data() + m->id_off[id];
+                const int64_t len = m->id_off[id + 1] - m->id_off[id];
+                const uint64_t hash = fnv1a(key, len);
+                uint64_t b = hash & m->mask;
+                Entry* e;
+                for (;;) {
+                    e = &m->buckets[b];
+                    if (e->key_off < 0) break;
+                    if (e->hash == hash && e->key_len == len &&
+                        memcmp(m->arena.data() + e->key_off, key, len) == 0)
+                        break;
+                    b = (b + 1) & m->mask;
                 }
-                const int32_t slot = m->free_slots.back();
-                m->free_slots.pop_back();
-                e->hash = hash;
-                e->key_off = static_cast<int64_t>(m->arena.size());
-                e->key_len = static_cast<int32_t>(len);
-                e->slot = slot;
-                m->arena.insert(m->arena.end(), key, key + len);
-                m->slot_bucket[slot] = static_cast<int64_t>(b);
-                m->size++;
+                if (e->key_off < 0) {
+                    if (m->free_slots.empty()) {
+                        w[0] = -1;
+                        for (int j = 1; j < PACK_W; j++) w[j] = 0;
+                        full++;
+                        continue;
+                    }
+                    const int32_t ns = m->free_slots.back();
+                    m->free_slots.pop_back();
+                    e->hash = hash;
+                    e->key_off = static_cast<int64_t>(m->arena.size());
+                    e->key_len = static_cast<int32_t>(len);
+                    e->slot = ns;
+                    m->arena.insert(m->arena.end(), key, key + len);
+                    m->slot_bucket[ns] = static_cast<int64_t>(b);
+                    m->size++;
+                }
+                slot = e->slot;
+                // Cache only an unclaimed slot: two interned ids with
+                // identical key bytes share a slot, and the reverse map
+                // can hold just one of them — the other stays slow-path.
+                if (m->slot_id[slot] < 0) {
+                    m->slot_id[slot] = static_cast<int32_t>(id);
+                    m->id_slot[id] = slot;
+                }
             }
-            w[0] = e->slot;
+            w[0] = slot;
             w[2] = 3;  // is_last | valid
-            if (e->batch_stamp == stamp) {
-                w[1] = ++e->batch_count - 1;
-                out[static_cast<int64_t>(e->batch_last_pos) * PACK_W + 2] &=
-                    ~1;
-                e->batch_last_pos = static_cast<int32_t>(i);
+            if (m->slot_stamp[slot] == stamp) {
+                w[1] = ++m->slot_count[slot] - 1;
+                out[static_cast<int64_t>(m->slot_last_pos[slot]) * PACK_W +
+                    2] &= ~1;
+                m->slot_last_pos[slot] = static_cast<int32_t>(i);
             } else {
                 w[1] = 0;
-                e->batch_stamp = stamp;
-                e->batch_count = 1;
-                e->batch_last_pos = static_cast<int32_t>(i);
+                m->slot_stamp[slot] = stamp;
+                m->slot_count[slot] = 1;
+                m->slot_last_pos[slot] = static_cast<int32_t>(i);
             }
             const int64_t em = em_by_id[id];
             const int64_t tol = tol_by_id[id];
@@ -366,6 +397,10 @@ int64_t tk_free_slots(void* h, const int32_t* slots, int64_t n) {
             j = (j + 1) & m->mask;
         }
         m->slot_bucket[slot] = -1;
+        if (m->slot_id[slot] >= 0) {
+            m->id_slot[m->slot_id[slot]] = -1;
+            m->slot_id[slot] = -1;
+        }
         m->free_slots.push_back(slot);
         m->size--;
         freed++;

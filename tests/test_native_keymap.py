@@ -120,3 +120,18 @@ def test_churn_against_python_reference():
             for k in drop:
                 del pydict[k]
         assert len(km) == len(pydict)
+
+
+def test_grow_keeps_probe_invariant():
+    """Regression: grow_slots must keep nbuckets >= 2x capacity.  The old
+    rehash sizing left nbuckets == capacity after a grow, so a full table
+    spun forever on the next miss probe instead of reporting full."""
+    from throttlecrab_tpu.native import NativeKeyMap
+
+    km = NativeKeyMap(64)
+    km.grow(128)
+    keys = [b"g:%d" % i for i in range(129)]
+    valid = np.ones(len(keys), bool)
+    slots, _, _, n_full = km.resolve(keys, valid)
+    assert n_full == 1  # 129 keys into 128 slots: one reported full
+    assert (slots >= 0).sum() == 128

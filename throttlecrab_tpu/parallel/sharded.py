@@ -617,10 +617,6 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
         executes window N."""
         if not batches:
             return _ReadyLaunch([])
-        if len(batches) == 1:
-            return _ReadyLaunch(
-                [self.rate_limit_batch(*batches[0], wire=wire)]
-            )
 
         prepared = []
         width = self.MIN_PAD
