@@ -123,8 +123,10 @@ def main() -> int:
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--json-extra", action="store_true")
-    ap.add_argument("--depth", type=int, default=64,
-                    help="micro-batches per device launch")
+    ap.add_argument("--depth", type=int, default=None,
+                    help="micro-batches per device launch (default: 256 "
+                         "on TPU where the ~300ms fixed per-launch relay "
+                         "cost dwarfs per-batch compute, else 64)")
     ap.add_argument("--pipe", type=int, default=4,
                     help="launches kept in flight")
     ap.add_argument("--profile", default=None,
@@ -157,9 +159,14 @@ def main() -> int:
 
     rng = np.random.default_rng(7)
     n_keys = 100_000 if args.quick else N_KEYS
-    depth = min(args.depth, 16) if args.quick else args.depth
+    depth = args.depth
+    if depth is None:
+        depth = 256 if device.platform == "tpu" else 64
+    if args.quick:
+        depth = min(depth, 16)
+    # Hold the timed workload near ~8M decisions regardless of depth.
     warm_launches = 2 if args.quick else 4
-    timed_launches = 4 if args.quick else 32
+    timed_launches = 4 if args.quick else max(8, 2048 // depth)
 
     limiter = TpuRateLimiter(capacity=1 << 21, keymap="auto", auto_grow=False)
     keymap_kind = type(limiter.keymap).__name__
