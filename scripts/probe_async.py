@@ -17,11 +17,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if "--cpu" in sys.argv:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
 import throttlecrab_tpu  # noqa: F401
 import jax
+
+if "--cpu" in sys.argv:
+    # Env var alone is not enough: the accelerator plugin in
+    # sitecustomize re-points JAX after the environment is read.
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
 from throttlecrab_tpu.tpu.kernel import gcra_scan

@@ -16,11 +16,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if "--cpu" in sys.argv:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
 import throttlecrab_tpu  # noqa: F401
 import jax
+
+if "--cpu" in sys.argv:
+    # Env var alone is not enough: the accelerator plugin in
+    # sitecustomize re-points JAX after the environment is read.
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
 from throttlecrab_tpu.tpu.kernel import (
@@ -123,3 +125,70 @@ for cap in (1 << 16, 1 << 18, 1 << 21):
 print("--- scan depth (full, cap=2^21) ---", flush=True)
 for K in (16, 64, 256):
     run(1 << 21, K, "full")
+
+
+# ---- d) honest d2h bandwidth: first fetch of a fresh device result -----
+# (profile_launch's d2h_ms was ~0: a second fetch of an already-fetched
+# buffer is host-cached.  This times the FIRST np.asarray per buffer.)
+print("--- d2h first-fetch cost by size ---", flush=True)
+mk = jax.jit(lambda x: x * 3 + 1)
+for mb in (1, 4, 16):
+    n_el = mb * (1 << 20) // 4
+    seeds = [jax.device_put(np.arange(n_el + i, dtype=np.int32), dev)
+             for i in range(4)]  # distinct shapes: no host-cache reuse
+    outs = [mk(x) for x in seeds]
+    t0 = time.perf_counter()
+    for o in outs:
+        np.asarray(o)
+    dt = (time.perf_counter() - t0) / len(outs)
+    print(f"d) d2h {mb:3d} MB first fetch: {dt*1e3:8.2f} ms "
+          f"({mb/dt:6.1f} MB/s)", flush=True)
+
+# ---- e) launch cost vs output size (fixed compute) ---------------------
+# Same scan body; output either the full compact [4, B] rows or just the
+# allowed bits as i8[B].  If the per-launch cost tracks output bytes, the
+# tunnel's result-fetch path is the bottleneck, not compute.
+print("--- launch cost vs output size (K=64) ---", flush=True)
+
+
+def make_scan_outsize(small_out):
+    @partial(jax.jit, donate_argnums=(0,))
+    def scan(state, slots, emission, tolerance, now):
+        def step(st, kb):
+            st2, out = body(st, kb, "full")
+            if small_out:
+                out = out.astype(jnp.int8)  # i8[B] allowed bits only
+            else:
+                out = jnp.stack([out, out + 1, out + 2, out + 3])  # [4, B]
+            return st2, out
+
+        return jax.lax.scan(
+            step, state, (slots, emission, tolerance, now.astype(jnp.int64))
+        )
+
+    return scan
+
+
+for small in (False, True):
+    cap, K = 1 << 21, 64
+    rng = np.random.default_rng(3)
+    state = make_state(cap)
+    slots = jax.device_put(
+        rng.integers(0, cap - 1, (K, B)).astype(np.int32), dev
+    )
+    em = jax.device_put(np.full((K, B), 20_000_000, np.int64), dev)
+    tol = jax.device_put(np.full((K, B), 1_000_000_000, np.int64), dev)
+    now = jax.device_put(np.full(K, NOW, np.int64), dev)
+    scan = make_scan_outsize(small)
+    state, out = scan(state, slots, em, tol, now)
+    np.asarray(out)
+    state, out = scan(state, slots, em, tol, now)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    for _ in range(4):
+        state, out = scan(state, slots, em, tol, now)
+        np.asarray(out)
+    dt = (time.perf_counter() - t0) / 4
+    label = "i8 allowed-only" if small else "i32 full compact"
+    print(f"e) {label:16s} out={out.size * out.dtype.itemsize / 1e6:5.1f} MB: "
+          f"{dt*1e3:8.2f} ms/launch ({K*B/dt/1e6:6.2f} M dec/s)", flush=True)

@@ -52,6 +52,7 @@ import numpy as np
 
 from ..tpu.limiter import (
     BatchResult,
+    _ReadyLaunch,
     STATUS_INTERNAL,
     STATUS_INVALID_PARAMS,
     ScalarCompatMixin,
@@ -575,29 +576,45 @@ class ClusterLimiter(ScalarCompatMixin):
         frame pipelining).  Per-key arrival order holds either way
         because a key always routes to the same node.
         """
+        return self.dispatch_many(batches, wire=wire).fetch()
+
+    def dispatch_many(self, batches, wire: bool = False):
+        """Dispatch/fetch split for the engine's double-buffered flush
+        loop.  Windows whose keys are ALL locally owned dispatch through
+        the local limiter's own split (the device lock covers only the
+        dispatch; launches are sequenced by the donated table state, so
+        the fetch can run lock-free later).  Windows with remote keys
+        decide synchronously inside this call — peer RPC and device work
+        interleave per batch — and return ready results."""
         if not batches:
-            return []
+            return _ReadyLaunch([])
+        can_async = hasattr(self.local, "dispatch_many")
         can_scan = hasattr(self.local, "rate_limit_many")
         # Partition each batch exactly once: the local-only probe hands its
         # partitions to the per-batch path instead of discarding them.
         parts = [self._encode_and_partition(b[0]) for b in batches]
-        if can_scan and len(batches) > 1:
-            local_only = all(
-                not bad.any()
-                and not any(
-                    len(ix)
-                    for d, ix in enumerate(by_node)
-                    if d != self.self_index
-                )
-                for _, bad, by_node in parts
+        local_only = (can_async or can_scan) and all(
+            not bad.any()
+            and not any(
+                len(ix)
+                for d, ix in enumerate(by_node)
+                if d != self.self_index
             )
-            if local_only:
-                with self.device_lock:
-                    return self.local.rate_limit_many(batches, wire=wire)
-        return [
-            self.rate_limit_batch(*b, wire=wire, _part=part)
-            for b, part in zip(batches, parts)
-        ]
+            for _, bad, by_node in parts
+        )
+        if local_only:
+            with self.device_lock:
+                if can_async:
+                    return self.local.dispatch_many(batches, wire=wire)
+                return _ReadyLaunch(
+                    self.local.rate_limit_many(batches, wire=wire)
+                )
+        return _ReadyLaunch(
+            [
+                self.rate_limit_batch(*b, wire=wire, _part=part)
+                for b, part in zip(batches, parts)
+            ]
+        )
 
     # ------------------------------------------------------------------ #
 
