@@ -133,7 +133,14 @@ def main() -> int:
                     help="capture an xprof trace of the timed region here")
     ap.add_argument("--legacy", action="store_true",
                     help="unpacked per-sub-batch resolve path")
+    ap.add_argument("--pallas", action="store_true",
+                    help="route table row gather/scatter through the "
+                         "Pallas DMA kernels (tpu/pallas_ops.py)")
     args = ap.parse_args()
+
+    if args.pallas:
+        # Must precede the first kernel trace (read at trace time).
+        os.environ["THROTTLECRAB_PALLAS"] = "1"
 
     fallback_reason = None
     if not args.cpu:
@@ -156,6 +163,14 @@ def main() -> int:
 
     device = jax.devices()[0]
     print(f"bench device: {device}", file=sys.stderr)
+    pallas_interpreted = args.pallas and device.platform != "tpu"
+    if pallas_interpreted:
+        print(
+            "WARNING: --pallas off-TPU runs the DMA kernels in interpret "
+            "mode — correct but orders of magnitude slower; this is NOT "
+            "a measurement of the Pallas path",
+            file=sys.stderr,
+        )
 
     rng = np.random.default_rng(7)
     n_keys = 100_000 if args.quick else N_KEYS
@@ -192,6 +207,8 @@ def main() -> int:
     extra = {
         "scan_depth": depth,
         "pipe": args.pipe,
+        "pallas": bool(args.pallas),
+        "pallas_interpreted": pallas_interpreted,
         "batch": BATCH,
         "n_keys": n_keys,
         "keymap": keymap_kind,

@@ -85,6 +85,15 @@ PACK_FLAG_IS_LAST = 1
 PACK_FLAG_VALID = 2
 
 
+def _pallas_rows() -> bool:
+    """Route the table row gather/scatter through the Pallas DMA kernels
+    (pallas_ops.py; THROTTLECRAB_PALLAS=1).  Read at trace time — the
+    first trace of each jit cache entry freezes the choice."""
+    from . import pallas_ops
+
+    return pallas_ops.enabled()
+
+
 def pack_state(tat, expiry):
     """(i64[N], i64[N]) → i32[N, 4] rows [tat_lo, tat_hi, exp_lo, exp_hi].
 
@@ -198,7 +207,12 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False):
     now = now.astype(jnp.int64)
 
     s = jnp.clip(slots, 0, N - 1).astype(jnp.int32)
-    stored_tat, stored_exp = unpack_state(state[s])
+    if _pallas_rows():
+        from . import pallas_ops
+
+        stored_tat, stored_exp = unpack_state(pallas_ops.row_gather(state, s))
+    else:
+        stored_tat, stored_exp = unpack_state(state[s])
     v = valid
     live = v & (stored_exp > now)
 
@@ -346,7 +360,14 @@ def _finish(
     scratch = N - B + jnp.arange(B, dtype=jnp.int32)
     scatter_idx = jnp.where(wrote, s, scratch).astype(jnp.int32)
     rows = pack_state(tat_fin, expiry_fin)
-    state = state.at[scatter_idx].set(rows, unique_indices=True, mode="drop")
+    if _pallas_rows():
+        from . import pallas_ops
+
+        state = pallas_ops.row_scatter(state, scatter_idx, rows)
+    else:
+        state = state.at[scatter_idx].set(
+            rows, unique_indices=True, mode="drop"
+        )
 
     # One stacked output → one device-to-host fetch.
     if compact:

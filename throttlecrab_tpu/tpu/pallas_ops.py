@@ -1,0 +1,162 @@
+"""Pallas TPU kernels for the bucket-table row gather/scatter.
+
+The CPU kernel ablation (scripts/probe_kernel_ablation.py, round 4) puts
+~85% of the decision kernel's time in the random-row gather + scatter
+over the [N, 4] i32 table; the GCRA math itself is cheap VPU work.  XLA
+lowers a 4096-row random scatter conservatively, so these kernels do the
+memory movement explicitly: a ring of small async DMAs (one 16-byte row
+each) that overlap address latency instead of serializing on it, per
+SURVEY §7.2 step 2's "drop to Pallas only if the gather/scatter
+dominates" — which the ablation showed it does.
+
+The i64 GCRA arithmetic stays in XLA (TPU vector lanes are 32-bit;
+reimplementing 64-bit div/mul in-kernel would be all risk for no gain) —
+the kernels move rows, XLA fuses the math between them.
+
+Enable with THROTTLECRAB_PALLAS=1, set before the first kernel trace
+(each jit cache entry freezes the choice at trace time).  Off-TPU the
+kernels run in interpret mode — correct but orders of magnitude slower
+(the DMA ring is emulated); that mode exists for the correctness tests,
+not for measurement.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_W = 4        # table row width (packed tat/expiry, kernel.pack_state)
+RING = 16        # DMAs kept in flight per program
+MAX_CHUNK = 512  # rows handled per grid program
+
+
+def enabled() -> bool:
+    """Whether the packed kernels route row movement through Pallas.
+    Reads the environment on every call, so setting THROTTLECRAB_PALLAS
+    before the first kernel trace is sufficient regardless of import
+    order (traces cache the value per jit entry)."""
+    return os.environ.get("THROTTLECRAB_PALLAS", "") not in ("", "0")
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _chunk(B: int) -> int:
+    c = min(MAX_CHUNK, B)
+    while B % c:
+        c //= 2
+    if c < min(RING, B):
+        # A chunk below the ring depth serializes the pipeline the
+        # kernel exists to provide; callers pad batches to powers of two
+        # (limiter MIN_PAD), so this only fires on misuse.
+        raise ValueError(
+            f"batch size {B} has no divisor >= {min(RING, B)} "
+            f"<= {MAX_CHUNK}; pad the batch to a power of two"
+        )
+    return c
+
+
+def _dma_pipeline(chunk: int, copy) -> None:
+    """Issue `chunk` row DMAs through a RING-deep in-flight window.
+
+    `copy(i)` must return the same descriptor for a given i on every
+    call (start and wait reconstruct it); the start/wait/drain
+    accounting lives here once so gather and scatter cannot diverge.
+    """
+
+    def body(i, _):
+        @pl.when(i >= RING)
+        def _():
+            copy(i - RING).wait()
+
+        copy(i).start()
+        return 0
+
+    jax.lax.fori_loop(0, chunk, body, 0)
+
+    def drain(i, _):
+        copy(jnp.maximum(chunk - RING, 0) + i).wait()
+        return 0
+
+    jax.lax.fori_loop(0, min(RING, chunk), drain, 0)
+
+
+def _gather_kernel(idx_ref, table_ref, out_ref, sem):
+    base = pl.program_id(0) * out_ref.shape[0]
+
+    def copy(i):
+        return pltpu.make_async_copy(
+            table_ref.at[idx_ref[base + i]],
+            out_ref.at[i],
+            sem.at[i % RING],
+        )
+
+    _dma_pipeline(out_ref.shape[0], copy)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def row_gather(table, idx):
+    """rows[i] = table[idx[i]] — [B] random rows out of an HBM-resident
+    [N, ROW_W] i32 table, via a RING-deep async-DMA pipeline."""
+    B = idx.shape[0]
+    chunk = _chunk(B)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B // chunk,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((chunk, ROW_W), lambda g, idx_ref: (g, 0)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((RING,))],
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, ROW_W), table.dtype),
+        interpret=_interpret(),
+    )(idx.astype(jnp.int32), table)
+
+
+def _scatter_kernel(idx_ref, rows_ref, table_ref, out_ref, sem):
+    base = pl.program_id(0) * rows_ref.shape[0]
+
+    def copy(i):
+        return pltpu.make_async_copy(
+            rows_ref.at[i],
+            out_ref.at[idx_ref[base + i]],
+            sem.at[i % RING],
+        )
+
+    _dma_pipeline(rows_ref.shape[0], copy)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def row_scatter(table, idx, rows):
+    """table[idx[i]] = rows[i] (idx unique by construction — the caller
+    redirects suppressed writes to distinct scratch rows); the table is
+    updated in place via input/output aliasing."""
+    B = idx.shape[0]
+    chunk = _chunk(B)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B // chunk,),
+        in_specs=[
+            pl.BlockSpec((chunk, ROW_W), lambda g, idx_ref: (g, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((RING,))],
+    )
+    return pl.pallas_call(
+        _scatter_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        # Operand indices include the scalar-prefetch arg: 0 = idx,
+        # 1 = rows, 2 = table → table aliases the output.
+        input_output_aliases={2: 0},
+        interpret=_interpret(),
+    )(idx.astype(jnp.int32), rows, table)
