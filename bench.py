@@ -265,7 +265,7 @@ def run_packed(
         return table.check_many_packed(
             packed.reshape(depth, BATCH, W),
             np.full(depth, now_ns, np.int64),
-            with_degen=False,  # certified: qty=1, burst>1, emission>0
+            with_degen=False,  # certified: qty=1, burst>1, emission>0, tol>0
             compact=True,
         )
 
@@ -458,7 +458,7 @@ def run_launch(limiter, key_src, idx_chunk, em_all, tol_all, now_ns, depth):
         np.ones(shape, np.int64),
         np.ones(shape, bool),
         np.full(k, now_ns, np.int64),
-        with_degen=False,  # host-certified: qty=1, burst>1, emission>0
+        with_degen=False,  # host-certified: qty=1, burst>1, emission>0, tol>0
         compact=True,  # i32 wire outputs, half the fetch bytes
     )
     return np.asarray(out)

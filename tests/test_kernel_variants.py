@@ -87,3 +87,23 @@ def test_compact_variant_truncates_to_seconds(nondegen_batch):
         np.testing.assert_array_equal(out_c[3], (out_e[3] // NS).astype(np.int32))
     # Real-slot table state identical regardless of output format.
     np.testing.assert_array_equal(np.asarray(st1)[:64], np.asarray(st2)[:64])
+
+
+def test_wrapped_negative_tolerance_certified_to_exact_path():
+    """derive_params can produce a negative (wrapped) tolerance from the
+    reference's truncating u64 product; such batches must be certified
+    degenerate so the fast path's nonneg saturating ops are never used
+    on them."""
+    from throttlecrab_tpu.tpu.limiter import derive_params, has_degenerate
+
+    # burst huge enough that emission * (burst-1) wraps negative.
+    em, tol, invalid = derive_params(
+        np.array([1 << 33], np.int64),
+        np.array([1], np.int64),
+        np.array([1 << 30], np.int64),
+    )
+    assert not invalid[0]
+    assert tol[0] < 0  # the wrap actually happened
+    assert has_degenerate(
+        np.array([True]), em, tol, np.array([1], np.int64)
+    )

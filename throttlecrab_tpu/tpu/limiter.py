@@ -106,15 +106,22 @@ class WireBatchResult:
 
 
 def has_degenerate(valid, emission, tolerance, quantity) -> bool:
-    """True when any valid request needs the kernel's degenerate-case
-    machinery: quantity-0 probes, burst-1 (tolerance 0), or zero emission
-    intervals.  When absent the engine compiles it out (`with_degen=False`,
+    """True when any valid request needs the kernel's exact path:
+    quantity-0 probes, burst-1 (tolerance 0), zero emission intervals, or
+    a wrapped-negative tolerance (the reference's truncating
+    emission*(burst-1) product can wrap, rate_limiter.rs:122).  When
+    absent the engine compiles the degenerate machinery out AND swaps the
+    general saturating ops for 2-op nonneg forms (`with_degen=False`,
     ~40% less VPU work) — certified per batch, so correctness never
     depends on traffic shape."""
     return bool(
         np.any(
             valid
-            & ((emission == 0) | (tolerance == 0) | (quantity == 0))
+            & (
+                (emission == 0)
+                | (tolerance <= 0)
+                | (quantity == 0)
+            )
         )
     )
 
@@ -369,7 +376,8 @@ class TpuRateLimiter(ScalarCompatMixin):
         `wire=True` takes the serving fast path: compact i32 whole-second
         outputs (returns WireBatchResult) and the degenerate-case kernel
         machinery compiled out whenever this batch provably has no
-        quantity-0 / burst-1 / zero-emission request.
+        quantity-0 / burst-1 / zero-emission / wrapped-negative-tolerance
+        request (see has_degenerate).
         """
         (n, max_burst, quantity, emission, tolerance, status, valid,
          slots, rank0, is_last0, rounds) = self._prepare_one(

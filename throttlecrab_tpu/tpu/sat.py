@@ -31,6 +31,22 @@ def sat_sub(a, b):
     return jnp.where(pos_of, I64_MAX, jnp.where(neg_of, I64_MIN, d))
 
 
+def sat_add_nn(a, b):
+    """i64 saturating a + b for b >= 0 (most GCRA additions add a
+    non-negative interval/tolerance): only positive overflow is
+    possible, and it manifests exactly as s < a — one compare + one
+    select instead of the general form's five ops."""
+    s = a + b
+    return jnp.where(s < a, I64_MAX, s)
+
+
+def sat_sub_nn(a, b):
+    """i64 saturating a - b for b >= 0: only negative overflow is
+    possible, manifesting exactly as d > a."""
+    d = a - b
+    return jnp.where(d > a, I64_MIN, d)
+
+
 def sat_mul_nonneg(a, b):
     """i64 saturating a * b for a, b >= 0 (the only case GCRA needs)."""
     safe_b = jnp.maximum(b, 1)
