@@ -70,6 +70,7 @@ struct KeyMap {
     std::vector<uint64_t> slot_stamp;
     std::vector<int32_t> slot_count;
     std::vector<int32_t> slot_last_pos;
+    std::vector<int32_t> slot_first_pos;
 
     explicit KeyMap(int64_t cap) { init(cap); }
 
@@ -88,7 +89,43 @@ struct KeyMap {
         slot_stamp.assign(cap, 0);
         slot_count.assign(cap, 0);
         slot_last_pos.assign(cap, -1);
+        slot_first_pos.assign(cap, -1);
         arena.reserve(cap * 16);
+    }
+
+    // Shared probe path for resolve / assemble / prepare: find the key's
+    // entry, inserting on miss.  Returns nullptr with *full=true when the
+    // slot table is exhausted.  Any change to probing or insertion
+    // invariants happens HERE, once.
+    Entry* find_or_insert(const char* key, int64_t len, bool* full) {
+        *full = false;
+        const uint64_t hash = fnv1a(key, len);
+        uint64_t b = hash & mask;
+        Entry* e;
+        for (;;) {
+            e = &buckets[b];
+            if (e->key_off < 0) break;  // miss
+            if (e->hash == hash && e->key_len == len &&
+                memcmp(arena.data() + e->key_off, key, len) == 0)
+                break;  // hit
+            b = (b + 1) & mask;
+        }
+        if (e->key_off < 0) {
+            if (free_slots.empty()) {
+                *full = true;
+                return nullptr;
+            }
+            const int32_t slot = free_slots.back();
+            free_slots.pop_back();
+            e->hash = hash;
+            e->key_off = static_cast<int64_t>(arena.size());
+            e->key_len = static_cast<int32_t>(len);
+            e->slot = slot;
+            arena.insert(arena.end(), key, key + len);
+            slot_bucket[slot] = static_cast<int64_t>(b);
+            size++;
+        }
+        return e;
     }
 
     void rehash(uint64_t nbuckets) {
@@ -114,6 +151,7 @@ struct KeyMap {
         slot_stamp.resize(new_cap, 0);
         slot_count.resize(new_cap, 0);
         slot_last_pos.resize(new_cap, -1);
+        slot_first_pos.resize(new_cap, -1);
         capacity = new_cap;
         // Keep nbuckets >= 2 * capacity (load factor <= 0.5): the probe
         // loops rely on an empty bucket always existing — at load factor
@@ -166,32 +204,12 @@ int64_t tk_lookup_insert_batch(
         }
         const char* key = keys + offsets[i];
         const int64_t len = offsets[i + 1] - offsets[i];
-        const uint64_t hash = fnv1a(key, len);
-        uint64_t b = hash & m->mask;
-        Entry* e;
-        for (;;) {
-            e = &m->buckets[b];
-            if (e->key_off < 0) break;  // miss
-            if (e->hash == hash && e->key_len == len &&
-                memcmp(m->arena.data() + e->key_off, key, len) == 0)
-                break;  // hit
-            b = (b + 1) & m->mask;
-        }
-        if (e->key_off < 0) {
-            if (m->free_slots.empty()) {
-                out_slots[i] = -1;
-                full++;
-                continue;
-            }
-            const int32_t slot = m->free_slots.back();
-            m->free_slots.pop_back();
-            e->hash = hash;
-            e->key_off = static_cast<int64_t>(m->arena.size());
-            e->key_len = static_cast<int32_t>(len);
-            e->slot = slot;
-            m->arena.insert(m->arena.end(), key, key + len);
-            m->slot_bucket[slot] = static_cast<int64_t>(b);
-            m->size++;
+        bool is_full = false;
+        Entry* e = m->find_or_insert(key, len, &is_full);
+        if (is_full) {
+            out_slots[i] = -1;
+            full++;
+            continue;
         }
         const int32_t slot = e->slot;
         out_slots[i] = slot;
@@ -276,33 +294,13 @@ int64_t tk_assemble(void* h, const int32_t* ids, int64_t total, int64_t batch,
                 // after a sweep freed the slot), then cache.
                 const char* key = m->id_arena.data() + m->id_off[id];
                 const int64_t len = m->id_off[id + 1] - m->id_off[id];
-                const uint64_t hash = fnv1a(key, len);
-                uint64_t b = hash & m->mask;
-                Entry* e;
-                for (;;) {
-                    e = &m->buckets[b];
-                    if (e->key_off < 0) break;
-                    if (e->hash == hash && e->key_len == len &&
-                        memcmp(m->arena.data() + e->key_off, key, len) == 0)
-                        break;
-                    b = (b + 1) & m->mask;
-                }
-                if (e->key_off < 0) {
-                    if (m->free_slots.empty()) {
-                        w[0] = -1;
-                        for (int j = 1; j < PACK_W; j++) w[j] = 0;
-                        full++;
-                        continue;
-                    }
-                    const int32_t ns = m->free_slots.back();
-                    m->free_slots.pop_back();
-                    e->hash = hash;
-                    e->key_off = static_cast<int64_t>(m->arena.size());
-                    e->key_len = static_cast<int32_t>(len);
-                    e->slot = ns;
-                    m->arena.insert(m->arena.end(), key, key + len);
-                    m->slot_bucket[ns] = static_cast<int64_t>(b);
-                    m->size++;
+                bool is_full = false;
+                Entry* e = m->find_or_insert(key, len, &is_full);
+                if (is_full) {
+                    w[0] = -1;
+                    for (int j = 1; j < PACK_W; j++) w[j] = 0;
+                    full++;
+                    continue;
                 }
                 slot = e->slot;
                 // Cache only an unclaimed slot: two interned ids with
@@ -337,6 +335,125 @@ int64_t tk_assemble(void* h, const int32_t* ids, int64_t total, int64_t batch,
         }
     }
     return full;
+}
+
+// ---------------------------------------------------------------------
+// Wire-batch preparation: the fully-native serving host path.
+//
+// One call takes a micro-batch exactly as the C++ wire layer hands it
+// over (concatenated key bytes + offsets + i64 (burst, count, period,
+// quantity) per request) and produces the kernel's packed launch rows:
+// per request it validates (reference error taxonomy), derives the GCRA
+// parameters with the exact f64 pipeline (rate/mod.rs:164-176 semantics:
+// f64 multiply/divide, truncating cast, wrapping tolerance product —
+// bit-identical to limiter.derive_params), resolves the slot, emits the
+// duplicate-segment structure, and writes the packed row.  Python's
+// per-batch work drops to padding + the device launch.
+//
+// Returns a flag bitmask; a nonzero TK_PREP_CONFLICT or TK_PREP_FULL
+// tells the caller to fall back to the exact Python path (param changes
+// mid-batch need the multi-round sub-protocol; full tables need growth).
+
+constexpr int64_t TK_PREP_DEGEN = 1;     // needs the exact kernel path
+constexpr int64_t TK_PREP_CONFLICT = 2;  // same key, different params
+constexpr int64_t TK_PREP_FULL = 4;      // slot table full
+
+constexpr uint8_t STATUS_OK = 0;
+constexpr uint8_t STATUS_NEGATIVE_QUANTITY = 1;
+constexpr uint8_t STATUS_INVALID_PARAMS = 2;
+
+int64_t tk_prepare_batch(void* h, const char* keys, const int64_t* offsets,
+                         int64_t n, const int64_t* params, int32_t* out,
+                         uint8_t* status) {
+    KeyMap* m = static_cast<KeyMap*>(h);
+    m->batch_stamp++;
+    const uint64_t stamp = m->batch_stamp;
+    int64_t flags = 0;
+    // Per-slot first-occurrence params for conflict detection, reset via
+    // the same stamp the segment tracking uses.
+    for (int64_t i = 0; i < n; i++) {
+        int32_t* w = out + i * PACK_W;
+        const int64_t burst = params[i * 4 + 0];
+        const int64_t count = params[i * 4 + 1];
+        const int64_t period = params[i * 4 + 2];
+        const int64_t qty = params[i * 4 + 3];
+
+        uint8_t st = STATUS_OK;
+        if (burst <= 0 || count <= 0 || period <= 0)
+            st = STATUS_INVALID_PARAMS;
+        if (qty < 0) st = STATUS_NEGATIVE_QUANTITY;
+        status[i] = st;
+        if (st != STATUS_OK) {
+            w[0] = -1;
+            for (int j = 1; j < PACK_W; j++) w[j] = 0;
+            continue;
+        }
+
+        // Exact f64 derivation (matches limiter.derive_params): numpy and
+        // C++ both follow IEEE-754 double semantics here.
+        const double emission_f =
+            static_cast<double>(period) * 1e9 / static_cast<double>(count);
+        int64_t em;
+        if (emission_f >= 9223372036854775808.0)  // 2^63
+            em = INT64_MAX;
+        else
+            em = static_cast<int64_t>(emission_f);
+        if (em < 0) em = 0;
+        const uint64_t b32 =
+            static_cast<uint64_t>(burst - 1) & 0xFFFFFFFFull;
+        const int64_t tol = static_cast<int64_t>(
+            static_cast<uint64_t>(em) * b32);  // wrapping, as reference
+
+        if (em == 0 || tol <= 0 || qty == 0) flags |= TK_PREP_DEGEN;
+
+        const char* key = keys + offsets[i];
+        const int64_t len = offsets[i + 1] - offsets[i];
+        bool is_full = false;
+        Entry* e = m->find_or_insert(key, len, &is_full);
+        if (is_full) {
+            w[0] = -1;
+            for (int j = 1; j < PACK_W; j++) w[j] = 0;
+            flags |= TK_PREP_FULL;
+            continue;
+        }
+        const int32_t slot = e->slot;
+        w[0] = slot;
+        w[2] = 3;  // is_last | valid
+        if (m->slot_stamp[slot] == stamp) {
+            w[1] = ++m->slot_count[slot] - 1;
+            out[static_cast<int64_t>(m->slot_last_pos[slot]) * PACK_W + 2] &=
+                ~1;
+            // Conflict: this occurrence's derived params must match the
+            // first occurrence's packed row (the kernel requires uniform
+            // params per slot per batch).
+            const int64_t f =
+                static_cast<int64_t>(m->slot_first_pos[slot]) * PACK_W;
+            const int32_t em_lo = static_cast<int32_t>(em & 0xFFFFFFFFll);
+            const int32_t em_hi = static_cast<int32_t>(em >> 32);
+            const int32_t tol_lo = static_cast<int32_t>(tol & 0xFFFFFFFFll);
+            const int32_t tol_hi = static_cast<int32_t>(tol >> 32);
+            const int32_t q_lo = static_cast<int32_t>(qty & 0xFFFFFFFFll);
+            const int32_t q_hi = static_cast<int32_t>(qty >> 32);
+            if (out[f + 3] != em_lo || out[f + 4] != em_hi ||
+                out[f + 5] != tol_lo || out[f + 6] != tol_hi ||
+                out[f + 7] != q_lo || out[f + 8] != q_hi)
+                flags |= TK_PREP_CONFLICT;
+            m->slot_last_pos[slot] = static_cast<int32_t>(i);
+        } else {
+            w[1] = 0;
+            m->slot_stamp[slot] = stamp;
+            m->slot_count[slot] = 1;
+            m->slot_last_pos[slot] = static_cast<int32_t>(i);
+            m->slot_first_pos[slot] = static_cast<int32_t>(i);
+        }
+        w[3] = static_cast<int32_t>(em & 0xFFFFFFFFll);
+        w[4] = static_cast<int32_t>(em >> 32);
+        w[5] = static_cast<int32_t>(tol & 0xFFFFFFFFll);
+        w[6] = static_cast<int32_t>(tol >> 32);
+        w[7] = static_cast<int32_t>(qty & 0xFFFFFFFFll);
+        w[8] = static_cast<int32_t>(qty >> 32);
+    }
+    return flags;
 }
 
 // Snapshot export: first call tk_export_sizes to size the buffers, then

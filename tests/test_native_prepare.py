@@ -1,0 +1,153 @@
+"""tk_prepare_batch (the fully-native serving prep) vs the Python path:
+derivation bit-parity, status taxonomy, segment structure, and end-to-end
+decision equality."""
+
+import numpy as np
+import pytest
+
+from throttlecrab_tpu.native import (
+    PREP_CONFLICT,
+    PREP_DEGEN,
+    PREP_FULL,
+    native_available,
+    toolchain_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not toolchain_available(), reason="no C++ toolchain"
+)
+
+NS = 1_000_000_000
+T0 = 1_700_000_000 * NS
+
+
+def frame(keys):
+    blob = b"".join(keys)
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    return blob, offsets
+
+
+def unpack_i64(packed, base):
+    lo = packed[:, base].view(np.uint32).astype(np.int64)
+    hi = packed[:, base + 1].astype(np.int64)
+    return (hi << 32) | lo
+
+
+def test_derivation_bit_parity_extremes():
+    """C++ f64 derivation must equal derive_params bit-for-bit, including
+    the truncating cast, clamp-to-I64_MAX, and wrapping tolerance."""
+    from throttlecrab_tpu.native import NativeKeyMap
+    from throttlecrab_tpu.tpu.limiter import derive_params
+
+    rng = np.random.default_rng(9)
+    n = 500
+    burst = np.concatenate([
+        rng.integers(1, 1 << 20, n - 8),
+        np.array([1, 2, 1 << 32, (1 << 33) + 5, 1 << 62, 3, 7, 1]),
+    ]).astype(np.int64)
+    count = np.concatenate([
+        rng.integers(1, 1 << 30, n - 8),
+        np.array([1, 1, 1, 1, 1 << 50, 1, 2, 10**15]),
+    ]).astype(np.int64)
+    period = np.concatenate([
+        rng.integers(1, 1 << 20, n - 8),
+        np.array([1, 1 << 40, 1 << 30, 1 << 30, 1, 1 << 55, 1, 1]),
+    ]).astype(np.int64)
+
+    em_py, tol_py, invalid = derive_params(burst, count, period)
+    assert not invalid.any()
+
+    km = NativeKeyMap(2048)
+    keys = [b"dp:%d" % i for i in range(n)]
+    blob, offsets = frame(keys)
+    params = np.stack(
+        [burst, count, period, np.ones(n, np.int64)], axis=1
+    )
+    packed, status, flags = km.prepare_batch(blob, offsets, params)
+    assert (status == 0).all()
+    np.testing.assert_array_equal(unpack_i64(packed, 3), em_py)
+    np.testing.assert_array_equal(unpack_i64(packed, 5), tol_py)
+
+
+def test_status_taxonomy_and_validity():
+    from throttlecrab_tpu.native import NativeKeyMap
+
+    km = NativeKeyMap(64)
+    keys = [b"ok", b"negq", b"zb", b"zc", b"zp"]
+    blob, offsets = frame(keys)
+    params = np.array(
+        [
+            [10, 100, 60, 1],
+            [10, 100, 60, -1],   # negative quantity
+            [0, 100, 60, 1],     # burst <= 0
+            [10, 0, 60, 1],      # count <= 0
+            [10, 100, -5, 1],    # period <= 0
+        ],
+        np.int64,
+    )
+    packed, status, flags = km.prepare_batch(blob, offsets, params)
+    assert status.tolist() == [0, 1, 2, 2, 2]
+    valid = (packed[:, 2] & 2) != 0
+    assert valid.tolist() == [True, False, False, False, False]
+    # Invalid requests must not allocate slots.
+    assert len(km) == 1
+
+
+def test_prepare_matches_python_decisions():
+    """Decisions through prepare_batch + packed kernel == the Python
+    rate_limit_batch path, duplicates included."""
+    import jax.numpy as jnp
+
+    from throttlecrab_tpu.native import NativeKeyMap
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    rng = np.random.default_rng(17)
+    B = 128
+    key_ids = rng.integers(0, 40, B)
+    keys = [b"pp:%d" % i for i in key_ids]
+    burst = 5 + (key_ids % 13)
+    count = 50 + (key_ids % 97)
+    period = 30 + (key_ids % 11)
+
+    # Python path.
+    lim_py = TpuRateLimiter(capacity=512, keymap="native")
+    res_py = lim_py.rate_limit_batch(
+        keys, burst, count, period, 1, T0, wire=True
+    )
+
+    # Native-prep path: prepare + packed scan on a fresh table.
+    lim_nat = TpuRateLimiter(capacity=512, keymap="native")
+    blob, offsets = frame(keys)
+    params = np.stack(
+        [burst, count, period, np.ones(B, np.int64)], axis=1
+    ).astype(np.int64)
+    packed, status, flags = lim_nat.keymap.prepare_batch(
+        blob, offsets, params
+    )
+    assert flags & (PREP_CONFLICT | PREP_FULL) == 0
+    out = np.asarray(
+        lim_nat.table.check_many_packed(
+            packed.reshape(1, B, 9),
+            np.array([T0], np.int64),
+            with_degen=bool(flags & PREP_DEGEN),
+            compact=True,
+        )
+    )[0]
+    np.testing.assert_array_equal(out[0] != 0, res_py.allowed)
+    np.testing.assert_array_equal(out[1], res_py.remaining)
+    np.testing.assert_array_equal(out[2], res_py.reset_after_s)
+    np.testing.assert_array_equal(out[3], res_py.retry_after_s)
+    assert status.tolist() == res_py.status.tolist()
+
+
+def test_prepare_full_table_flagged():
+    from throttlecrab_tpu.native import NativeKeyMap
+
+    km = NativeKeyMap(2)
+    keys = [b"f1", b"f2", b"f3"]
+    blob, offsets = frame(keys)
+    params = np.array([[10, 100, 60, 1]] * 3, np.int64)
+    packed, status, flags = km.prepare_batch(blob, offsets, params)
+    assert flags & PREP_FULL
+    assert packed[2, 0] == -1 and (packed[2, 2] & 2) == 0

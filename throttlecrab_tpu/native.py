@@ -97,6 +97,11 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
     ]
+    lib.tk_prepare_batch.restype = ctypes.c_int64
+    lib.tk_prepare_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
     lib.tk_export_sizes.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
     ]
@@ -191,6 +196,12 @@ def wire_build_error() -> Optional[str]:
     """The wire-server build failure (with compiler stderr), or None."""
     get_wire_lib()
     return _ws_error
+
+
+# Flag bits returned by NativeKeyMap.prepare_batch (keymap.cpp TK_PREP_*).
+PREP_DEGEN = 1
+PREP_CONFLICT = 2
+PREP_FULL = 4
 
 
 class NativeKeyMap:
@@ -305,6 +316,44 @@ class NativeKeyMap:
             out.ctypes.data_as(ctypes.c_void_p),
         )
         return out, int(n_full)
+
+    def prepare_batch(
+        self,
+        key_blob: bytes,
+        offsets: np.ndarray,
+        params: np.ndarray,
+        out: Optional[np.ndarray] = None,
+    ):
+        """The fully-native serving prep: validate + derive GCRA params
+        (exact f64 pipeline) + resolve slots + segment structure + packed
+        rows, in ONE C++ pass over the wire-shaped batch.
+
+        `key_blob`/`offsets[n+1]` frame the keys; `params` is i64[n, 4]
+        (burst, count, period, quantity).  Returns (packed i32[n, 9],
+        status u8[n], flags).  flags & (PREP_CONFLICT | PREP_FULL) means
+        the caller must fall back to the Python path (mid-batch param
+        change / table growth); PREP_DEGEN means decide with the exact
+        kernel (with_degen=True)."""
+        from .tpu.kernel import PACK_WIDTH
+
+        n = len(offsets) - 1
+        params = np.ascontiguousarray(params, np.int64)
+        if params.shape != (n, 4):
+            raise ValueError("params must be i64[n, 4]")
+        offsets = np.ascontiguousarray(offsets, np.int64)
+        if out is None:
+            out = np.empty((n, PACK_WIDTH), np.int32)
+        status = np.empty(n, np.uint8)
+        flags = self._lib.tk_prepare_batch(
+            self._h,
+            key_blob,
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            n,
+            params.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            status.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out, status, int(flags)
 
     def free_slots(self, slot_indices: np.ndarray) -> int:
         arr = np.ascontiguousarray(slot_indices, np.int32)

@@ -140,23 +140,33 @@ class NativeRedisTransport:
         )
 
     def _capture(self, n: int):
-        """Snapshot the reusable batch buffers into per-batch arrays."""
-        offsets = self._offsets
+        """Snapshot the reusable batch buffers into a per-batch frame:
+        (key_blob, offsets, params[n, 4], cookie_gen, cookie_fd) — the
+        exact shape dispatch_wire_window consumes, with keys derived
+        lazily only on the fallback path."""
+        offsets = self._offsets[: n + 1].copy()
         # Copy only the used prefix, not the whole reusable buffer.
         blob = ctypes.string_at(self._key_buf, int(offsets[n]))
-        keys = [blob[offsets[i] : offsets[i + 1]] for i in range(n)]
+        params = self._params[: 4 * n].reshape(n, 4).copy()
+        return (
+            blob,
+            offsets,
+            params,
+            self._cookie_gen[:n].copy(),
+            self._cookie_fd[:n].copy(),
+        )
+
+    def _keys_of(self, blob, offsets):
+        keys = [
+            blob[offsets[i] : offsets[i + 1]]
+            for i in range(len(offsets) - 1)
+        ]
         if not limiter_uses_bytes_keys(self.limiter):
             # Match the identity the str-keyed transports use, so one
             # client key maps to one bucket across HTTP/gRPC/RESP.
             # surrogateescape keeps arbitrary bytes unique and lossless.
             keys = [k.decode("utf-8", "surrogateescape") for k in keys]
-        p = self._params[: 4 * n]
-        return (
-            keys,
-            p[0::4].copy(), p[1::4].copy(), p[2::4].copy(), p[3::4].copy(),
-            self._cookie_gen[:n].copy(),
-            self._cookie_fd[:n].copy(),
-        )
+        return keys
 
     def _drive(self) -> None:
         """The decide loop: block for a batch; when a full batch arrives
@@ -197,29 +207,63 @@ class NativeRedisTransport:
 
     def _decide_window(self, batches) -> None:
         now_ns = self.now_fn()
-        try:
-            with self.limiter_lock:
-                # wire=True: compact i32 whole-second outputs straight off
-                # the device — the RESP/HTTP reply units — plus the
-                # degenerate-case kernel compiled out when certifiable.
-                if len(batches) == 1:
-                    keys, mb, cp, pd, qt, _, _ = batches[0]
-                    results = [
-                        self.limiter.rate_limit_batch(
-                            keys, mb, cp, pd, qt, now_ns, wire=True
-                        )
-                    ]
-                else:
-                    results = self.limiter.rate_limit_many(
-                        [
-                            (keys, mb, cp, pd, qt, now_ns)
-                            for keys, mb, cp, pd, qt, _, _ in batches
-                        ],
-                        wire=True,
+        results = None
+        # Fast path: hand the raw wire frames to the fully-native prep —
+        # one C++ call per batch validates, derives the GCRA params, and
+        # writes the packed launch rows (limiter.dispatch_wire_window).
+        wire_dispatch = getattr(self.limiter, "dispatch_wire_window", None)
+        handle = None
+        if wire_dispatch is not None:
+            try:
+                with self.limiter_lock:
+                    handle = wire_dispatch(
+                        [(b, o, p) for b, o, p, _, _ in batches], now_ns
                     )
-        except Exception:
-            log.exception("native redis decide failed")
-            results = [None] * len(batches)
+            except Exception:
+                # Failed BEFORE any launch committed state: the Python
+                # fallback below may safely re-decide.
+                log.exception("native wire dispatch failed")
+                handle = None
+        if handle is not None:
+            try:
+                results = handle.fetch()
+            except Exception:
+                # The launch already mutated the bucket table — the
+                # decisions are committed even though we cannot read
+                # them.  Re-deciding would debit every bucket twice, so
+                # answer internal errors instead of falling back.
+                log.exception("native wire fetch failed (post-launch)")
+                results = [None] * len(batches)
+        if results is None:
+            try:
+                with self.limiter_lock:
+                    # wire=True: compact i32 whole-second outputs straight
+                    # off the device — the RESP/HTTP reply units — plus
+                    # the degenerate machinery compiled out when
+                    # certifiable.
+                    windows = [
+                        (
+                            self._keys_of(b, o),
+                            p[:, 0], p[:, 1], p[:, 2], p[:, 3],
+                            now_ns,
+                        )
+                        for b, o, p, _, _ in batches
+                    ]
+                    if (
+                        hasattr(self.limiter, "rate_limit_many")
+                        and len(windows) > 1
+                    ):
+                        results = self.limiter.rate_limit_many(
+                            windows, wire=True
+                        )
+                    else:
+                        results = [
+                            self.limiter.rate_limit_batch(*w, wire=True)
+                            for w in windows
+                        ]
+            except Exception:
+                log.exception("native redis decide failed")
+                results = [None] * len(batches)
         # Metrics: ONE aggregated record for the whole window — it was
         # one device launch (record_batch bumps device_launches, so
         # per-sub-batch calls would overcount launches by up to
@@ -231,11 +275,9 @@ class NativeRedisTransport:
             and self.metrics.top_denied is not None
         )
         any_launch = False
-        for (keys, _mb, _cp, _pd, _qt, gen, fd), res in zip(
-            batches, results
-        ):
+        for (blob, offsets, _p, gen, fd), res in zip(batches, results):
             n_a, n_d, n_e, dk = self._respond_one(
-                keys, gen, fd, res, track_denied
+                blob, offsets, gen, fd, res, track_denied
             )
             tot_allowed += n_a
             tot_denied += n_d
@@ -253,12 +295,14 @@ class NativeRedisTransport:
                 denied_keys=denied_keys,
                 batch=tot_allowed + tot_denied + tot_errors,
             )
-        self._maybe_sweep(now_ns, sum(len(b[0]) for b in batches))
+        self._maybe_sweep(now_ns, sum(len(b[1]) - 1 for b in batches))
 
-    def _respond_one(self, keys, cookie_gen, cookie_fd, res, track_denied):
+    def _respond_one(
+        self, blob, offsets, cookie_gen, cookie_fd, res, track_denied
+    ):
         """Serialize one sub-batch's replies; returns (n_allowed,
         n_denied, n_errors, denied_keys) for the caller's aggregate."""
-        n = len(keys)
+        n = len(offsets) - 1
         results = np.zeros(5 * n, np.int64)
         if res is None:
             status = np.full(n, STATUS_INTERNAL, np.uint8)
@@ -282,16 +326,13 @@ class NativeRedisTransport:
         )
         ok = status == 0
         allowed_mask = results.reshape(n, 5)[:, 0] != 0
-        denied_keys = (
-            [
-                k.decode("utf-8", "replace") if isinstance(k, bytes) else k
-                for k in (
-                    keys[i] for i in np.flatnonzero(~allowed_mask & ok)
-                )
+        if track_denied:
+            denied_keys = [
+                blob[offsets[i] : offsets[i + 1]].decode("utf-8", "replace")
+                for i in np.flatnonzero(~allowed_mask & ok)
             ]
-            if track_denied
-            else []
-        )
+        else:
+            denied_keys = []
         return (
             int((allowed_mask & ok).sum()),
             int((~allowed_mask & ok).sum()),

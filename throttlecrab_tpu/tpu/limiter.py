@@ -257,11 +257,14 @@ def derive_params(max_burst, count_per_period, period):
     invalid = (max_burst <= 0) | (count_per_period <= 0) | (period <= 0)
     safe_count = np.where(count_per_period == 0, 1, count_per_period)
     emission_f = period.astype(np.float64) * 1e9 / safe_count.astype(np.float64)
-    emission = np.where(
-        emission_f >= float(1 << 63),
-        I64_MAX,
-        emission_f.astype(np.int64),
-    )
+    with np.errstate(invalid="ignore"):
+        # Out-of-range casts are overridden by the I64_MAX clamp below;
+        # numpy's warning about them is noise.
+        emission = np.where(
+            emission_f >= float(1 << 63),
+            I64_MAX,
+            emission_f.astype(np.int64),
+        )
     emission = np.where(emission < 0, 0, emission)
 
     b32 = (max_burst - 1).astype(np.uint64) & np.uint64(0xFFFFFFFF)
@@ -324,6 +327,34 @@ class _PendingLaunch:
                         **fields,
                     )
                 )
+        return results
+
+
+class _PendingWireLaunch:
+    """In-flight launch from dispatch_wire_window; .fetch() distributes
+    the compact device output into per-frame WireBatchResults."""
+
+    def __init__(self, out_dev, prepared) -> None:
+        self._out_dev = out_dev
+        self._prepared = prepared
+
+    def fetch(self) -> list:
+        out = np.asarray(self._out_dev)
+        results = []
+        for j, (packed, status, params) in enumerate(self._prepared):
+            n = len(status)
+            o = out[j, :, :n]
+            valid = (packed[:, 2] & 2) != 0
+            results.append(
+                WireBatchResult(
+                    allowed=(o[0] != 0) & valid,
+                    limit=np.where(valid, params[:, 0], 0),
+                    remaining=np.where(valid, o[1], 0),
+                    reset_after_s=np.where(valid, o[2], 0),
+                    retry_after_s=np.where(valid, o[3], 0),
+                    status=status,
+                )
+            )
         return results
 
 
@@ -596,6 +627,57 @@ class TpuRateLimiter(ScalarCompatMixin):
         return _PendingLaunch(out_dev, prepared, valid_s, wire)
 
     # ------------------------------------------------------------------ #
+
+    def dispatch_wire_window(self, frames, now_ns: int):
+        """The fully-native serving dispatch: each frame is
+        (key_blob, offsets i64[n+1], params i64[n, 4]) exactly as the C++
+        wire layer hands batches over.  One C++ call per frame validates,
+        derives GCRA params (exact f64 pipeline), resolves slots, and
+        writes the packed rows (native/keymap.cpp tk_prepare_batch);
+        Python's per-batch work is reduced to pow-2 padding and the
+        launch.  Returns a handle with .fetch() -> [WireBatchResult], or
+        None when the window needs the exact Python path (non-native
+        keymap, a mid-batch param change, or a full table — preparation
+        is idempotent, so the fallback simply re-resolves)."""
+        km = self.keymap
+        if not hasattr(km, "prepare_batch"):
+            return None
+        if now_ns < 0:
+            # Part of the with_degen=False certificate (kernel.py): the
+            # nonneg saturating forms require now >= 0.  Same contract as
+            # _prepare_one.
+            raise ValueError(
+                "batch now_ns must be non-negative; apply "
+                "normalize_now_ns per request for pre-epoch clocks"
+            )
+        from ..native import PREP_CONFLICT, PREP_DEGEN, PREP_FULL
+
+        prepared = []
+        width = self.MIN_PAD
+        any_degen = False
+        for blob, offsets, params in frames:
+            packed, status, flags = km.prepare_batch(blob, offsets, params)
+            if flags & (PREP_CONFLICT | PREP_FULL):
+                return None
+            any_degen = any_degen or bool(flags & PREP_DEGEN)
+            prepared.append((packed, status, params))
+            n = len(status)
+            width = max(width, 1 << max(n - 1, 0).bit_length())
+
+        from .kernel import PACK_WIDTH
+
+        K = len(prepared)
+        K_pad = 1 << max(K - 1, 0).bit_length()
+        stack = np.zeros((K_pad, width, PACK_WIDTH), np.int32)
+        for j, (packed, _, _) in enumerate(prepared):
+            stack[j, : len(packed)] = packed
+        out_dev = self.table.check_many_packed(
+            stack,
+            np.full(K_pad, now_ns, np.int64),
+            with_degen=any_degen,
+            compact=True,
+        )
+        return _PendingWireLaunch(out_dev, prepared)
 
     def sweep(self, now_ns: int) -> int:
         """Run a cleanup sweep; returns the number of slots freed."""
