@@ -24,27 +24,28 @@ GRPC_PORT = 28070
 REDIS_PORT = 28060
 
 
-@pytest.fixture(scope="module")
-def server():
+def spawn_server(*extra_args):
+    """Spawn the real server module on the CPU backend (shared by the
+    module fixture and the restart tests)."""
     env = dict(os.environ)
     env["THROTTLECRAB_PLATFORM"] = "cpu"
     env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.Popen(
+    return subprocess.Popen(
         [
             sys.executable, "-m", "throttlecrab_tpu.server",
-            "--http", "--http-port", str(HTTP_PORT),
-            "--grpc", "--grpc-port", str(GRPC_PORT),
-            "--redis", "--redis-port", str(REDIS_PORT),
-            "--store", "adaptive", "--log-level", "warn",
+            "--store", "adaptive", "--log-level", "warn", *extra_args,
         ],
         env=env,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,
     )
-    deadline = time.time() + 120
+
+
+def wait_health(proc, http_port, deadline_s=120):
+    deadline = time.time() + deadline_s
     last_err = None
     while time.time() < deadline:
         if proc.poll() is not None:
@@ -52,16 +53,25 @@ def server():
             pytest.fail(f"server exited early rc={proc.returncode}:\n{out}")
         try:
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{HTTP_PORT}/health", timeout=1
+                f"http://127.0.0.1:{http_port}/health", timeout=1
             ) as r:
                 assert r.read() == b"OK"
-            break
+            return
         except Exception as e:  # noqa: BLE001 - retry until deadline
             last_err = e
             time.sleep(0.5)
-    else:
-        proc.terminate()
-        pytest.fail(f"server never became healthy: {last_err}")
+    proc.terminate()
+    pytest.fail(f"server never became healthy: {last_err}")
+
+
+@pytest.fixture(scope="module")
+def server():
+    proc = spawn_server(
+        "--http", "--http-port", str(HTTP_PORT),
+        "--grpc", "--grpc-port", str(GRPC_PORT),
+        "--redis", "--redis-port", str(REDIS_PORT),
+    )
+    wait_health(proc, HTTP_PORT)
     yield proc
     proc.terminate()
     try:
@@ -181,3 +191,43 @@ def test_metrics_visible_after_traffic(server):
         text = r.read().decode()
     assert "throttlecrab_requests_total" in text
     assert "throttlecrab_requests_by_transport" in text
+
+
+def test_snapshot_survives_restart(tmp_path):
+    """--snapshot-path: exhaust a burst, SIGTERM the server, restart with
+    the same path — the key must still be exhausted (state restored).
+    Uses a suffix-less path on purpose: numpy appends .npz on save, and
+    the restore side must normalize identically or silently start cold."""
+    snap = str(tmp_path / "state")  # note: no .npz suffix
+    port = 28085
+
+    def throttle():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/throttle",
+            data=json.dumps(
+                {"key": "snap:k", "max_burst": 3,
+                 "count_per_period": 10, "period": 3600}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())["allowed"]
+
+    args = ("--http", "--http-port", str(port), "--snapshot-path", snap)
+    proc = spawn_server(*args)
+    try:
+        wait_health(proc, port)
+        assert [throttle() for _ in range(4)] == [True, True, True, False]
+    finally:
+        proc.terminate()
+    assert proc.wait(timeout=60) == 0
+    assert os.path.exists(snap + ".npz")
+
+    proc = spawn_server(*args)
+    try:
+        wait_health(proc, port)
+        # Still exhausted across the restart.
+        assert throttle() is False
+    finally:
+        proc.terminate()
+        proc.wait(timeout=60)

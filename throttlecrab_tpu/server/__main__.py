@@ -124,6 +124,30 @@ async def run_server(config: Config) -> None:
             connect_timeout_s=config.cluster_connect_timeout_ms / 1000.0,
         )
         metrics.set_cluster_stats_provider(limiter.peer_stats)
+    if config.snapshot_path:
+        import os as _os
+        import time as _time
+
+        from ..tpu.snapshot import _normalize
+
+        if _os.path.exists(_normalize(config.snapshot_path)):
+            from ..tpu.snapshot import load_snapshot
+
+            try:
+                restored = load_snapshot(
+                    limiter, config.snapshot_path, _time.time_ns()
+                )
+                log.info(
+                    "restored %d keys from snapshot %s",
+                    restored, config.snapshot_path,
+                )
+            except Exception:
+                # Soft state: a bad snapshot degrades to a cold start,
+                # never to a refused boot or wrong decisions.
+                log.exception(
+                    "snapshot restore failed; starting cold (%s)",
+                    config.snapshot_path,
+                )
     engine = BatchingEngine(
         limiter,
         batch_size=config.batch_size,
@@ -190,6 +214,20 @@ async def run_server(config: Config) -> None:
     await engine.shutdown()
     for transport in transports:
         await transport.stop()
+    if config.snapshot_path:
+        from ..tpu.snapshot import save_snapshot
+
+        try:
+            with engine.limiter_lock:
+                saved = save_snapshot(limiter, config.snapshot_path)
+            log.info(
+                "saved %d keys to snapshot %s",
+                saved, config.snapshot_path,
+            )
+        except Exception:
+            log.exception(
+                "snapshot save failed (%s)", config.snapshot_path
+            )
     for task in serve_tasks:
         task.cancel()
     await asyncio.gather(*serve_tasks, stop_task, return_exceptions=True)

@@ -72,6 +72,9 @@ _SPEC = [
      "Number of devices to shard the bucket table over"),
     ("profile_dir", "THROTTLECRAB_PROFILE_DIR", "", str,
      "Directory for an xprof trace of the first launches (empty: off)"),
+    ("snapshot_path", "THROTTLECRAB_SNAPSHOT_PATH", "", str,
+     "Snapshot file (.npz): restored at startup when present, written on "
+     "graceful shutdown (empty: disabled; state is soft either way)"),
     ("cluster_nodes", "THROTTLECRAB_CLUSTER_NODES", "", str,
      "Comma-separated host:port cluster RPC addresses of every node "
      "(same list on every node; empty: single-node)"),
@@ -122,6 +125,7 @@ class Config:
     keymap: str = "auto"
     shards: int = 1
     profile_dir: str = ""
+    snapshot_path: str = ""
     cluster_nodes: str = ""
     cluster_index: int = 0
     cluster_bind_host: str = "0.0.0.0"

@@ -53,6 +53,17 @@ def _encode_keys(pairs):
     return slots, keys, key_is_bytes, key_codec
 
 
+def _normalize(path: Union[str, Path]) -> Path:
+    """np.savez_compressed appends .npz to suffix-less paths; normalize
+    BOTH save and load so `--snapshot-path /data/state` round-trips
+    (otherwise the save writes /data/state.npz and the restore's
+    exists-check on /data/state silently never fires)."""
+    path = Path(path)
+    if path.suffix != ".npz":
+        path = Path(str(path) + ".npz")
+    return path
+
+
 def save_snapshot(limiter, path: Union[str, Path]) -> int:
     """Write the limiter's live state to `path` (.npz); returns #keys saved.
 
@@ -69,7 +80,7 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
     if local is not None:  # ClusterLimiter
         return save_snapshot(local, path)
 
-    path = Path(path)
+    path = _normalize(path)
     if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
         # [D, rows, 4] packed i32 — one gather off the mesh.
         state = np.asarray(limiter.table.state)
@@ -159,7 +170,7 @@ def load_snapshot(limiter, path: Union[str, Path], now_ns: int) -> int:
 
     if len(limiter) != 0:
         raise ValueError("restore requires an empty limiter")
-    path = Path(path)
+    path = _normalize(path)
     with np.load(path) as data:
         version = int(data["version"])
         if version not in (1, FORMAT_VERSION):
