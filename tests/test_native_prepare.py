@@ -151,3 +151,56 @@ def test_prepare_full_table_flagged():
     packed, status, flags = km.prepare_batch(blob, offsets, params)
     assert flags & PREP_FULL
     assert packed[2, 0] == -1 and (packed[2, 2] & 2) == 0
+
+
+def test_wire_window_differential_random():
+    """dispatch_wire_window vs the Python path over many randomized
+    windows on twin limiters: same keys, params (including degenerate
+    mixes), duplicates, and interleaved sweeps — every output field must
+    match exactly, window after window (state carried on both sides)."""
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    rng = np.random.default_rng(23)
+    lim_a = TpuRateLimiter(capacity=512, keymap="native")
+    lim_b = TpuRateLimiter(capacity=512, keymap="native")
+
+    now = T0
+    for round_i in range(12):
+        now += int(rng.integers(0, 3 * NS))
+        k_batches = int(rng.integers(1, 4))
+        frames = []
+        windows = []
+        for _ in range(k_batches):
+            n = int(rng.integers(1, 48))
+            key_ids = rng.integers(0, 30, n)
+            keys = [b"dw:%d" % i for i in key_ids]
+            burst = (1 + (key_ids % 7)).astype(np.int64)      # incl. burst 1
+            count = (1 + (key_ids % 19)).astype(np.int64)
+            period = (1 + (key_ids % 5)).astype(np.int64)
+            qty = (key_ids % 3).astype(np.int64)              # incl. qty 0
+            params = np.stack([burst, count, period, qty], axis=1)
+            blob, offsets = frame(keys)
+            frames.append((blob, offsets, params))
+            windows.append((keys, burst, count, period, qty, now))
+
+        handle = lim_a.dispatch_wire_window(frames, now)
+        assert handle is not None
+        res_a = handle.fetch()
+        res_b = [
+            lim_b.rate_limit_batch(*w, wire=True) for w in windows
+        ]
+        for j, (a, b) in enumerate(zip(res_a, res_b)):
+            msg = f"round {round_i} window {j}"
+            np.testing.assert_array_equal(a.allowed, b.allowed, msg)
+            np.testing.assert_array_equal(a.remaining, b.remaining, msg)
+            np.testing.assert_array_equal(
+                a.reset_after_s, b.reset_after_s, msg
+            )
+            np.testing.assert_array_equal(
+                a.retry_after_s, b.retry_after_s, msg
+            )
+            np.testing.assert_array_equal(a.status, b.status, msg)
+            np.testing.assert_array_equal(a.limit, b.limit, msg)
+        if round_i % 4 == 3:
+            now += 10 * NS
+            assert lim_a.sweep(now) == lim_b.sweep(now)

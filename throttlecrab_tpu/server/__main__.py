@@ -143,11 +143,18 @@ async def run_server(config: Config) -> None:
                 )
             except Exception:
                 # Soft state: a bad snapshot degrades to a cold start,
-                # never to a refused boot or wrong decisions.
+                # never to a refused boot or wrong decisions.  A partial
+                # restore may have populated the keymap (no rollback in
+                # bulk insert) — sweep everything so "cold" is real, not
+                # a table full of dead entries rejecting new keys.
                 log.exception(
                     "snapshot restore failed; starting cold (%s)",
                     config.snapshot_path,
                 )
+                try:
+                    limiter.sweep(1 << 62)
+                except Exception:
+                    log.exception("post-restore-failure sweep failed")
     engine = BatchingEngine(
         limiter,
         batch_size=config.batch_size,

@@ -126,27 +126,34 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
     if keys:
         np.cumsum([len(k) for k in keys], out=offsets[1:])
     key_blob = b"".join(keys)
-    np.savez_compressed(
-        path,
-        version=np.int64(FORMAT_VERSION),
-        capacity=np.int64(capacity),
-        slots=slots,
-        shard=shard,
-        n_shards=np.int64(getattr(limiter, "n_shards", 1)),
-        tat=tat,
-        expiry=expiry,
-        key_offsets=offsets,
-        key_blob=np.frombuffer(key_blob, np.uint8),
-        key_is_bytes=np.asarray(key_is_bytes, np.uint8),
-        key_codec=np.asarray(key_codec, np.uint8),
-        # The source keymap's key mode: a bytes-keyed (native) keymap
-        # stores every key as bytes even when the transports spoke str —
-        # the restore side needs this to translate identities correctly.
-        source_bytes_keys=np.uint8(limiter_uses_bytes_keys(limiter)),
-        meta=np.frombuffer(
-            json.dumps({"n_keys": len(keys)}).encode(), np.uint8
-        ),
-    )
+    # Atomic write: a kill mid-save must never clobber the previous good
+    # snapshot (np.savez_compressed writes the destination in place).
+    import os
+
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f,
+            version=np.int64(FORMAT_VERSION),
+            capacity=np.int64(capacity),
+            slots=slots,
+            shard=shard,
+            n_shards=np.int64(getattr(limiter, "n_shards", 1)),
+            tat=tat,
+            expiry=expiry,
+            key_offsets=offsets,
+            key_blob=np.frombuffer(key_blob, np.uint8),
+            key_is_bytes=np.asarray(key_is_bytes, np.uint8),
+            key_codec=np.asarray(key_codec, np.uint8),
+            # The source keymap's key mode: a bytes-keyed (native) keymap
+            # stores every key as bytes even when the transports spoke str —
+            # the restore side needs this to translate identities correctly.
+            source_bytes_keys=np.uint8(limiter_uses_bytes_keys(limiter)),
+            meta=np.frombuffer(
+                json.dumps({"n_keys": len(keys)}).encode(), np.uint8
+            ),
+        )
+    os.replace(tmp, path)
     return len(keys)
 
 
