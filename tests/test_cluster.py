@@ -450,3 +450,48 @@ def test_cluster_batch_failfast_when_breaker_open():
     assert res.allowed.tolist() == [False, True]
     stats = cl.peer_stats()
     assert stats["127.0.0.1:1"]["failed"] >= 2
+
+
+def test_cluster_wire_window_delegates_when_local():
+    """Single-node clusters (and all-local windows) keep the fully-native
+    wire path; a window containing a remote-owned key returns None and
+    routes through the forwarding path instead."""
+    from throttlecrab_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("no C++ keymap")
+
+    def make_frames(keys):
+        blob = b"".join(keys)
+        offsets = np.zeros(len(keys) + 1, np.int64)
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+        params = np.array([[3, 10, 3600, 1]] * len(keys), np.int64)
+        return [(blob, offsets, params)]
+
+    # Single node: always delegates.
+    cl1 = ClusterLimiter(
+        TpuRateLimiter(capacity=128, keymap="native"), ["127.0.0.1:1"], 0
+    )
+    handle = cl1.dispatch_wire_window(make_frames([b"w:a", b"w:b"]), T0)
+    assert handle is not None
+    res = handle.fetch()[0]
+    assert res.allowed.tolist() == [True, True]
+
+    # Two nodes: all-local window delegates, remote-containing one won't.
+    local_key = next(
+        b"wl:%d" % i for i in range(10_000)
+        if node_of_key(b"wl:%d" % i, 2) == 0
+    )
+    remote_key = next(
+        b"wr:%d" % i for i in range(10_000)
+        if node_of_key(b"wr:%d" % i, 2) == 1
+    )
+    cl2 = ClusterLimiter(
+        TpuRateLimiter(capacity=128, keymap="native"),
+        ["127.0.0.1:1", "127.0.0.1:2"], 0,
+    )
+    assert cl2.dispatch_wire_window(make_frames([local_key]), T0) is not None
+    assert (
+        cl2.dispatch_wire_window(make_frames([local_key, remote_key]), T0)
+        is None
+    )

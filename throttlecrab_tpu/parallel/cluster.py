@@ -578,6 +578,25 @@ class ClusterLimiter(ScalarCompatMixin):
         """
         return self.dispatch_many(batches, wire=wire).fetch()
 
+    def dispatch_wire_window(self, frames, now_ns: int):
+        """Cluster front for the fully-native wire path: windows whose
+        keys are ALL locally owned delegate to the local limiter's
+        dispatch_wire_window (ownership checked on the raw key bytes —
+        no decode); any remote-owned key returns None, routing the
+        window through the per-batch forwarding path."""
+        inner = getattr(self.local, "dispatch_wire_window", None)
+        if inner is None:
+            return None
+        n_nodes = len(self.nodes)
+        if n_nodes > 1:
+            for blob, offsets, _params in frames:
+                for i in range(len(offsets) - 1):
+                    kb = blob[offsets[i] : offsets[i + 1]]
+                    if node_of_key(kb, n_nodes) != self.self_index:
+                        return None
+        with self.device_lock:
+            return inner(frames, now_ns)
+
     def dispatch_many(self, batches, wire: bool = False):
         """Dispatch/fetch split for the engine's double-buffered flush
         loop.  Windows whose keys are ALL locally owned dispatch through
