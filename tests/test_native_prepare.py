@@ -9,7 +9,6 @@ from throttlecrab_tpu.native import (
     PREP_CONFLICT,
     PREP_DEGEN,
     PREP_FULL,
-    native_available,
     toolchain_available,
 )
 
@@ -97,9 +96,6 @@ def test_status_taxonomy_and_validity():
 def test_prepare_matches_python_decisions():
     """Decisions through prepare_batch + packed kernel == the Python
     rate_limit_batch path, duplicates included."""
-    import jax.numpy as jnp
-
-    from throttlecrab_tpu.native import NativeKeyMap
     from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
 
     rng = np.random.default_rng(17)

@@ -23,7 +23,7 @@ store flavors) and triggers the expiry-compaction sweep on the device.
 from __future__ import annotations
 
 import asyncio
-from typing import List, Optional, Tuple
+from typing import Optional
 
 from ..tpu.cleanup import CleanupPolicy
 from ..tpu.limiter import (
