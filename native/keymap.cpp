@@ -405,6 +405,13 @@ int64_t tk_prepare_batch(void* h, const char* keys, const int64_t* offsets,
             static_cast<uint64_t>(em) * b32);  // wrapping, as reference
 
         if (em == 0 || tol <= 0 || qty == 0) flags |= TK_PREP_DEGEN;
+        // Segment-arithmetic overflow certificate (must mirror
+        // limiter.has_degenerate): inc * MAX_SEGMENT must stay below
+        // 2^62 or the kernel's certified plain multiplies could wrap.
+        if (static_cast<double>(em) * static_cast<double>(qty > 1 ? qty : 1)
+                * 65536.0
+            >= 4611686018427387904.0)  // 2^62
+            flags |= TK_PREP_DEGEN;
 
         const char* key = keys + offsets[i];
         const int64_t len = offsets[i + 1] - offsets[i];

@@ -107,3 +107,69 @@ def test_wrapped_negative_tolerance_certified_to_exact_path():
     assert has_degenerate(
         np.array([True]), em, tol, np.array([1], np.int64)
     )
+
+
+def test_huge_increment_certified_to_exact_path():
+    """An increment big enough that segment products could overflow i64
+    must fail the fast-path certificate (both the Python and the C++
+    certifier), so the kernel's certified plain multiplies are never fed
+    overflowing products."""
+    from throttlecrab_tpu.tpu.limiter import derive_params, has_degenerate
+
+    # period huge, count 1 -> emission ~ period * 1e9 ns, near i64 max.
+    em, tol, invalid = derive_params(
+        np.array([2], np.int64),
+        np.array([1], np.int64),
+        np.array([1 << 33], np.int64),
+    )
+    assert not invalid[0] and tol[0] > 0
+    assert has_degenerate(
+        np.array([True]), em, tol, np.array([1], np.int64)
+    )
+
+    from throttlecrab_tpu.native import PREP_DEGEN, toolchain_available
+
+    if toolchain_available():
+        from throttlecrab_tpu.native import NativeKeyMap
+
+        km = NativeKeyMap(16)
+        packed, status, flags = km.prepare_batch(
+            b"big", np.array([0, 3], np.int64),
+            np.array([[2, 1, 1 << 33, 1]], np.int64),
+        )
+        assert status[0] == 0 and (flags & PREP_DEGEN)
+
+
+def test_mul_certificate_bounds_pinned_across_certifiers():
+    """MAX_SEGMENT derives from the table scratch bound, and the C++
+    certifier's hardcoded constants must agree with the Python one at
+    the boundary."""
+    from throttlecrab_tpu.tpu.limiter import MAX_SEGMENT, has_degenerate
+    from throttlecrab_tpu.tpu.table import BucketTable
+
+    assert MAX_SEGMENT == BucketTable.SCRATCH
+    from throttlecrab_tpu.parallel.sharded import ShardedBucketTable
+
+    assert MAX_SEGMENT == ShardedBucketTable.SCRATCH
+
+    from throttlecrab_tpu.native import toolchain_available
+
+    if not toolchain_available():
+        return
+    from throttlecrab_tpu.native import NativeKeyMap, PREP_DEGEN
+
+    # Probe both sides of the boundary with (burst=2, count=1, period=p):
+    # emission = p * 1e9, quantity 1.
+    for period, expect_degen in ((1 << 14, False), (1 << 28, True)):
+        em = np.array([float(period) * 1e9], np.float64).astype(np.int64)
+        tol = em.copy()
+        py = has_degenerate(
+            np.array([True]), em, tol, np.array([1], np.int64)
+        )
+        km = NativeKeyMap(16)
+        _, status, flags = km.prepare_batch(
+            b"b", np.array([0, 1], np.int64),
+            np.array([[2, 1, period, 1]], np.int64),
+        )
+        assert status[0] == 0
+        assert bool(flags & PREP_DEGEN) == py == expect_degen, period

@@ -230,19 +230,30 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False):
 
     em = emission
     tol = tolerance
-    inc = sat_mul_nonneg(em, quantity)
 
     # The with_degen=False certificate (has_degenerate + the engine's
     # now_ns >= 0 validation; direct kernel callers must uphold both)
-    # guarantees tol > 0, em >= 0, inc >= 0 and now >= 0, which licenses
-    # the 2-op nonneg saturating forms at the call sites below — every
-    # second operand there is tol, em, now, or a sat_mul_nonneg product.
-    # On the exact path the same names bind the GENERAL ops (wrapped
-    # tolerance can be negative), so s_add/s_sub carry no precondition.
+    # guarantees tol > 0, em >= 0, inc >= 0, now >= 0, AND
+    # inc * MAX_SEGMENT < 2^63 — which licenses the 2-op nonneg
+    # saturating forms below (every second operand is tol, em, now, or a
+    # segment product) and PLAIN multiplies for the segment arithmetic
+    # (a saturating multiply hides an i64 division in its overflow
+    # probe).  No certified product can overflow, via two different
+    # arguments: rank-bounded multipliers (quantity's inc, rank+1, and
+    # min(m_raw, rank+1)) are <= MAX_SEGMENT with inc*MAX_SEGMENT
+    # certified < 2^62; the UNCLAMPED m_raw multiplier is instead bounded
+    # by the division identity m_raw = num // inc => m_raw*inc <= num.
+    # On the exact path the same names bind the GENERAL ops, so
+    # s_add/s_sub/s_mul carry no precondition there.
     if with_degen:
-        s_add, s_sub = sat_add, sat_sub
+        s_add, s_sub, s_mul = sat_add, sat_sub, sat_mul_nonneg
     else:
         s_add, s_sub = sat_add_nn, sat_sub_nn
+
+        def s_mul(a, b):
+            return a * b
+
+    inc = s_mul(em, quantity)
 
     # Initial TAT of the segment: stored value clamped to now - tol, or the
     # first-touch value now - emission (rate_limiter.rs:158-166).  Identical
@@ -258,15 +269,17 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False):
     m_raw = jnp.maximum(div_trunc(num, inc), 0)
     allowed_main = rank < m_raw
 
-    new_tat_r = s_add(t0, sat_mul_nonneg(rank + 1, inc))
+    new_tat_r = s_add(t0, s_mul(rank + 1, inc))
     # Observed TAT: own new_tat when allowed; t0 + m_raw*inc when denied
-    # (all m_raw allowed requests precede any denied one).
-    tat_denied = s_add(t0, sat_mul_nonneg(m_raw, inc))
+    # (all m_raw allowed requests precede any denied one).  m_raw*inc
+    # never overflows on the certified path: m_raw = num // inc, so the
+    # product is <= num, itself bounded by now + tol - t0.
+    tat_denied = s_add(t0, s_mul(m_raw, inc))
     cur_main = jnp.where(allowed_main, new_tat_r, tat_denied)
     # Segment write-back, evaluated at the is_last position where the
     # segment size is rank + 1.
     tat_fin_main = s_add(
-        t0, sat_mul_nonneg(jnp.minimum(m_raw, rank + 1), inc)
+        t0, s_mul(jnp.minimum(m_raw, rank + 1), inc)
     )
 
     burst_limit = s_add(now, tol)

@@ -105,15 +105,33 @@ class WireBatchResult:
     status: np.ndarray
 
 
+# Segment arithmetic in the fast path multiplies inc by at most the
+# batch size (segment ranks); certifying inc * MAX_SEGMENT < 2^62 on the
+# host lets the kernel use plain multiplies instead of saturating ones
+# (each saturating multiply hides an i64 division for its overflow
+# probe).  Derived from the table scratch bound — the hard cap on batch
+# width and therefore on any segment rank.  native/keymap.cpp mirrors
+# the same certificate (tk_prepare_batch); a test pins the two together.
+MAX_SEGMENT = BucketTable.SCRATCH
+_MUL_SAFE = float(1 << 62)
+
+
 def has_degenerate(valid, emission, tolerance, quantity) -> bool:
     """True when any valid request needs the kernel's exact path:
-    quantity-0 probes, burst-1 (tolerance 0), zero emission intervals, or
-    a wrapped-negative tolerance (the reference's truncating
-    emission*(burst-1) product can wrap, rate_limiter.rs:122).  When
-    absent the engine compiles the degenerate machinery out AND swaps the
-    general saturating ops for 2-op nonneg forms (`with_degen=False`,
-    ~40% less VPU work) — certified per batch, so correctness never
+    quantity-0 probes, burst-1 (tolerance 0), zero emission intervals, a
+    wrapped-negative tolerance (the reference's truncating
+    emission*(burst-1) product can wrap, rate_limiter.rs:122), or an
+    increment big enough that segment arithmetic could overflow i64.
+    When absent the engine compiles the degenerate machinery out AND
+    swaps the general saturating ops for cheap certified forms
+    (`with_degen=False`) — certified per batch, so correctness never
     depends on traffic shape."""
+    big_inc = (
+        emission.astype(np.float64)
+        * np.maximum(quantity, 1).astype(np.float64)
+        * float(MAX_SEGMENT)
+        >= _MUL_SAFE
+    )
     return bool(
         np.any(
             valid
@@ -121,6 +139,7 @@ def has_degenerate(valid, emission, tolerance, quantity) -> bool:
                 (emission == 0)
                 | (tolerance <= 0)
                 | (quantity == 0)
+                | big_inc
             )
         )
     )
