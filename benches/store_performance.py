@@ -28,9 +28,11 @@ def bench_scenario(limiter, name, key_ids, batch, iters, params, now0):
     n = len(key_ids)
     burst, count, period = params
     keys = [f"bench:{i}" for i in range(int(key_ids.max()) + 1)]
-    # warmup / compile
+    # warmup / compile (wire=True: the serving fast path — compact i32
+    # outputs + certified kernel — is what every transport runs).
     limiter.rate_limit_batch(
-        [keys[i] for i in key_ids[:batch]], burst, count, period, 1, now0
+        [keys[i] for i in key_ids[:batch]], burst, count, period, 1, now0,
+        wire=True,
     )
     t0 = time.perf_counter()
     for it in range(iters):
@@ -39,7 +41,7 @@ def bench_scenario(limiter, name, key_ids, batch, iters, params, now0):
             sel = np.concatenate([sel, key_ids[: batch - len(sel)]])
         limiter.rate_limit_batch(
             [keys[i] for i in sel], burst, count, period, 1,
-            now0 + it * 1_000_000,
+            now0 + it * 1_000_000, wire=True,
         )
     dt = time.perf_counter() - t0
     rate = iters * batch / dt
@@ -117,7 +119,7 @@ def main() -> int:
                 sel = np.concatenate([sel, ids[: B - len(sel)]])
             now = now0 + it * 1_000_000
             limiter.rate_limit_batch(
-                [keys[i] for i in sel], *params, 1, now
+                [keys[i] for i in sel], *params, 1, now, wire=True
             )
             policy.record_ops(B)
             if policy.should_clean(now, len(limiter), limiter.total_capacity):
