@@ -250,7 +250,19 @@ def run_packed(
     limiter, keys, em_all, tol_all, rng, n_keys, depth, pipe,
     warm_launches, timed_launches, profile_dir, extra,
 ):
-    """The round-4 path: C++ launch assembly + pipelined packed dispatch."""
+    """The round-4 path: C++ launch assembly + pipelined packed dispatch.
+
+    Output side (the launch-dominating cost — the tunnel serves d2h at
+    ~10-50 MB/s, scripts/probe_d2h.py): the kernel's compact="cur" mode
+    returns ONE i64 per request (8 B instead of the 4-plane compact's
+    16 B), `copy_to_host_async` starts every transfer at dispatch time so
+    it overlaps later launches' compute, fetches run on a small thread
+    pool (the relay serves concurrent transfers ~4x faster than serial
+    blocking reads), and the exact i32 wire values are completed on the
+    host by C++ tk_finish at memory speed.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
     from throttlecrab_tpu.tpu.kernel import PACK_WIDTH as W
 
     km = limiter.keymap
@@ -262,12 +274,20 @@ def run_packed(
     def dispatch(ids, now_ns):
         packed, n_full = km.assemble(ids, BATCH, em_all, tol_all, 1)
         assert not n_full
-        return table.check_many_packed(
+        out = table.check_many_packed(
             packed.reshape(depth, BATCH, W),
             np.full(depth, now_ns, np.int64),
-            with_degen=False,  # certified: qty=1, burst>1, emission>0, tol>0
-            compact=True,
+            with_degen=False,  # certified: qty=1, burst>1, emission>0,
+            compact="cur",     # tol>0, now/tol < 2**61 (fits_cur_wire)
         )
+        out.copy_to_host_async()  # start the d2h now, not at fetch time
+        return packed, out, now_ns
+
+    def complete(packed, out, now_ns):
+        """Fetch the 8 B/request device words and finish the exact i32
+        wire values (allowed, remaining, reset_s, retry_s) in C++."""
+        cur2 = np.asarray(out)
+        return km.finish(packed, cur2, now_ns)
 
     # ---- populate: every key once, pipelined, no per-chunk blocking ------
     t_pop = time.perf_counter()
@@ -277,7 +297,7 @@ def run_packed(
         chunk = pop_order[start : start + per_launch]
         ids = np.full(per_launch, -1, np.int32)
         ids[: len(chunk)] = chunk
-        pending.append(dispatch(ids, T0))
+        pending.append(dispatch(ids, T0)[1])
         if len(pending) > pipe:
             np.asarray(pending.popleft())
     while pending:
@@ -313,11 +333,14 @@ def run_packed(
     ]
 
     # Warm (compiles are already done from populate; this settles the pipe).
+    pool = ThreadPoolExecutor(max_workers=3)
     pending = deque()
     for li in range(warm_launches):
-        pending.append(dispatch(chunks[li], T0 + li * 50_000_000))
+        pending.append(pool.submit(complete, *dispatch(
+            chunks[li], T0 + li * 50_000_000
+        )))
     while pending:
-        np.asarray(pending.popleft())
+        pending.popleft().result()
 
     import contextlib
 
@@ -336,17 +359,20 @@ def run_packed(
         for li in range(warm_launches, n_launches):
             t_dispatch[li] = time.perf_counter()
             pending.append(
-                (li, dispatch(chunks[li], T0 + li * 50_000_000))
+                (li, pool.submit(complete, *dispatch(
+                    chunks[li], T0 + li * 50_000_000
+                )))
             )
             if len(pending) > pipe:
-                j, out = pending.popleft()
-                np.asarray(out)
+                j, fut = pending.popleft()
+                fut.result()
                 latencies.append(time.perf_counter() - t_dispatch[j])
         while pending:
-            j, out = pending.popleft()
-            np.asarray(out)
+            j, fut = pending.popleft()
+            fut.result()
             latencies.append(time.perf_counter() - t_dispatch[j])
         elapsed = time.perf_counter() - t_start
+    pool.shutdown()
 
     decided = timed_launches * per_launch
     lat = np.sort(np.asarray(latencies))

@@ -337,6 +337,51 @@ int64_t tk_assemble(void* h, const int32_t* ids, int64_t total, int64_t batch,
     return full;
 }
 
+// Host-side completion of the kernel's compact="cur" device output:
+// reconstruct the exact 4-plane wire values (allowed, remaining,
+// reset_after_secs, retry_after_secs — i32, saturated exactly like the
+// kernel's compact branch) from ONE i64 `cur*2 + allowed` per request,
+// reading emission/tolerance/quantity back out of the packed request
+// rows the caller already holds.  Under the fits_cur_wire +
+// with_degen=False certificate (kernel.py) no intermediate can leave
+// i64, so plain arithmetic reproduces the device's saturating ops
+// bit-for-bit.  Moving these two i64 divisions off the device halves
+// the launch's device→host bytes AND removes emulated 64-bit VPU work.
+void tk_finish(const int32_t* packed, const int64_t* cur2, int64_t n,
+               int64_t now, int32_t* out) {
+    constexpr int64_t I32MAX = 2147483647ll;
+    constexpr int64_t NSEC = 1000000000ll;
+    for (int64_t i = 0; i < n; i++) {
+        const int32_t* w = packed + i * PACK_W;
+        const int64_t em =
+            (static_cast<int64_t>(w[4]) << 32) |
+            static_cast<uint32_t>(w[3]);
+        const int64_t tol =
+            (static_cast<int64_t>(w[6]) << 32) |
+            static_cast<uint32_t>(w[5]);
+        const int64_t qty =
+            (static_cast<int64_t>(w[8]) << 32) |
+            static_cast<uint32_t>(w[7]);
+        const int64_t c2 = cur2[i];
+        const int64_t allowed = c2 & 1;
+        const int64_t cur = c2 >> 1;  // arithmetic: exact for negatives
+        const int64_t room = now + tol - cur;
+        int64_t remaining = em > 0 ? room / em : 0;
+        if (remaining < 0) remaining = 0;
+        int64_t reset = cur - now + tol;
+        if (reset < 0) reset = 0;
+        int64_t retry = allowed ? 0 : cur + em * qty - tol - now;
+        if (retry < 0) retry = 0;
+        int32_t* o = out + i * 4;
+        o[0] = static_cast<int32_t>(allowed);
+        o[1] = static_cast<int32_t>(remaining < I32MAX ? remaining : I32MAX);
+        const int64_t reset_s = reset / NSEC;
+        o[2] = static_cast<int32_t>(reset_s < I32MAX ? reset_s : I32MAX);
+        const int64_t retry_s = retry / NSEC;
+        o[3] = static_cast<int32_t>(retry_s < I32MAX ? retry_s : I32MAX);
+    }
+}
+
 // ---------------------------------------------------------------------
 // Wire-batch preparation: the fully-native serving host path.
 //

@@ -1,0 +1,143 @@
+"""Find the fastest device→host fetch strategy through the tunnel.
+
+Round-4 ablation (docs/tpu-launch-profile.md) measured first-fetch d2h at
+~10-30 MB/s with ~60 ms fixed cost per blocking fetch — making output fetch
+the dominant cost of every launch (16 MB compact output at depth 256 ≈ 1 s).
+This probe times every fetch strategy the JAX API offers to find which one
+the relay serves fastest, plus the output-shrink axis (bytes per decision).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import throttlecrab_tpu  # noqa: F401
+import jax
+
+if "--cpu" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+dev = jax.devices()[0]
+print(f"device: {dev}", file=sys.stderr, flush=True)
+
+mk = jax.jit(lambda x: x * 3 + 1)
+
+
+def fresh_outputs(n, mb):
+    """n distinct never-fetched device buffers of `mb` MB each."""
+    n_el = mb * (1 << 20) // 4
+    outs = []
+    for i in range(n):
+        seed = jax.device_put(np.arange(n_el, dtype=np.int32) + i, dev)
+        outs.append(mk(seed))
+    for o in outs:
+        o.block_until_ready()  # settle compute; NOT a fetch
+    time.sleep(0.3)
+    return outs
+
+
+def timed(label, fn, outs):
+    t0 = time.perf_counter()
+    res = fn(outs)
+    dt = time.perf_counter() - t0
+    total_mb = sum(o.size * o.dtype.itemsize for o in outs) / 1e6
+    print(
+        f"{label:34s}: {dt*1e3:8.1f} ms total "
+        f"({total_mb:6.1f} MB, {total_mb/dt:7.1f} MB/s)",
+        flush=True,
+    )
+    del res
+    return dt
+
+
+N, MB = 4, 4
+
+# a) serial np.asarray (the bench's current strategy)
+timed("a) serial np.asarray", lambda outs: [np.asarray(o) for o in outs],
+      fresh_outputs(N, MB))
+
+# b) copy_to_host_async all first, then asarray
+def strat_async(outs):
+    for o in outs:
+        o.copy_to_host_async()
+    return [np.asarray(o) for o in outs]
+
+timed("b) copy_to_host_async then asarray", strat_async, fresh_outputs(N, MB))
+
+# c) one jax.device_get over the whole list
+timed("c) jax.device_get(list)", jax.device_get, fresh_outputs(N, MB))
+
+# d) thread-pool fetches (4 workers)
+def strat_threads(outs):
+    with ThreadPoolExecutor(4) as ex:
+        return list(ex.map(np.asarray, outs))
+
+timed("d) 4-thread np.asarray", strat_threads, fresh_outputs(N, MB))
+
+# e) one big buffer vs many small: 16 x 1MB vs 1 x 16MB
+timed("e) 16 x 1 MB serial", lambda outs: [np.asarray(o) for o in outs],
+      fresh_outputs(16, 1))
+timed("e) 1 x 16 MB", lambda outs: [np.asarray(o) for o in outs],
+      fresh_outputs(1, 16))
+
+# f) does dtype matter at equal bytes? (i8 vs i32)
+mk8 = jax.jit(lambda x: (x * 3 + 1).astype(jnp.int8))
+def fresh8(n, mb):
+    n_el = mb * (1 << 20)
+    outs = []
+    for i in range(n):
+        seed = jax.device_put(
+            np.arange(n_el, dtype=np.int32) % 100 + i, dev
+        )
+        outs.append(mk8(seed))
+    for o in outs:
+        o.block_until_ready()
+    time.sleep(0.3)
+    return outs
+
+timed("f) i8 same bytes serial", lambda outs: [np.asarray(o) for o in outs],
+      fresh8(N, MB))
+
+# g) latency floor: 4 KB buffers
+timed("g) 4 x 4 KB serial",
+      lambda outs: [np.asarray(o) for o in outs],
+      fresh_outputs(4, 4096 / (1 << 20)) if False else fresh_outputs(4, 1))
+# (1 MB is the smallest size fresh_outputs supports cleanly; use raw here)
+small = []
+for i in range(4):
+    seed = jax.device_put(np.arange(1024, dtype=np.int32) + i, dev)
+    small.append(mk(seed))
+for o in small:
+    o.block_until_ready()
+time.sleep(0.3)
+t0 = time.perf_counter()
+for o in small:
+    np.asarray(o)
+dt = time.perf_counter() - t0
+print(f"g) 4 x 4 KB serial              : {dt*1e3:8.1f} ms total "
+      f"({dt/4*1e3:6.1f} ms each)", flush=True)
+
+# h) fetch overlap with compute: dispatch a long chain, then fetch a
+# ready earlier output — does the fetch wait for the chain?
+chain = jax.device_put(np.arange(1 << 20, dtype=np.int32), dev)
+ready = mk(jax.device_put(np.arange(1 << 22, dtype=np.int32), dev))
+ready.block_until_ready()
+time.sleep(0.3)
+for _ in range(200):
+    chain = mk(chain)
+t0 = time.perf_counter()
+np.asarray(ready)
+dt_r = time.perf_counter() - t0
+t0 = time.perf_counter()
+np.asarray(chain)
+dt_c = time.perf_counter() - t0
+print(f"h) fetch ready-while-busy: {dt_r*1e3:.1f} ms; "
+      f"then chain drain: {dt_c*1e3:.1f} ms", flush=True)

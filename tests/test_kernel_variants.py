@@ -173,3 +173,101 @@ def test_mul_certificate_bounds_pinned_across_certifiers():
         )
         assert status[0] == 0
         assert bool(flags & PREP_DEGEN) == py == expect_degen, period
+
+
+def test_cur_variant_matches_compact(nondegen_batch):
+    """compact="cur" (one i64/request, host-finished) must reproduce the
+    4-plane compact wire output bit-for-bit and leave identical state."""
+    from throttlecrab_tpu.tpu.kernel import finish_cur, fits_cur_wire
+
+    slots, rank, is_last, em, tol, q, valid = nondegen_batch
+    assert fits_cur_wire(tol, BASE + 30 * NS)
+    st1 = make_table()
+    st2 = make_table()
+    for now in (BASE, BASE, BASE + 2 * NS, BASE + 30 * NS):
+        st1, out_c = run(
+            st1, *nondegen_batch, now, with_degen=False, compact=True
+        )
+        st2, cur2 = run(
+            st2, *nondegen_batch, now, with_degen=False, compact="cur"
+        )
+        cur2 = np.asarray(cur2)
+        assert cur2.dtype == np.int64 and cur2.shape == (64,)
+        out_c = np.asarray(out_c)
+        al, rem, res, ret = finish_cur(cur2, em, tol, q, now)
+        np.testing.assert_array_equal(al, out_c[0])
+        np.testing.assert_array_equal(rem, out_c[1])
+        np.testing.assert_array_equal(res, out_c[2])
+        np.testing.assert_array_equal(ret, out_c[3])
+    np.testing.assert_array_equal(np.asarray(st1)[:64], np.asarray(st2)[:64])
+
+
+def test_cur_variant_negative_cur_roundtrip():
+    """A denied fresh segment at a virtual now=0 clock observes
+    cur = t0 = -emission < 0 (quantity 3 against burst 2 → m_raw = 0, all
+    denied); the *2+allowed packing must survive the sign (arithmetic
+    shift decode) and still finish exactly."""
+    from throttlecrab_tpu.tpu.kernel import finish_cur
+
+    B = 8
+    slots = np.arange(B, dtype=np.int32)
+    rank = np.zeros(B, np.int32)
+    is_last = np.ones(B, bool)
+    em = np.full(B, 600_000_000, np.int64)
+    tol = em.copy()  # burst 2
+    q = np.full(B, 3, np.int64)  # inc = 3*em > now + tol → m_raw = 0
+    valid = np.ones(B, bool)
+    batch = (slots, rank, is_last, em, tol, q, valid)
+    for now in (0, 1):
+        st1, out_c = run(
+            make_table(), *batch, now, with_degen=False, compact=True
+        )
+        st2, cur2 = run(
+            make_table(), *batch, now, with_degen=False, compact="cur"
+        )
+        cur2 = np.asarray(cur2)
+        assert (cur2 >> 1).min() < 0  # the negative case actually occurs
+        assert not (np.asarray(out_c)[0]).any()  # and everything is denied
+        al, rem, res, ret = finish_cur(cur2, em, tol, q, now)
+        out_c = np.asarray(out_c)
+        np.testing.assert_array_equal(al, out_c[0])
+        np.testing.assert_array_equal(rem, out_c[1])
+        np.testing.assert_array_equal(res, out_c[2])
+        np.testing.assert_array_equal(ret, out_c[3])
+
+
+def test_native_finish_matches_numpy(nondegen_batch):
+    """C++ tk_finish == kernel.finish_cur on the same packed rows."""
+    from throttlecrab_tpu.native import toolchain_available
+
+    if not toolchain_available():
+        import pytest
+
+        pytest.skip("no C++ toolchain")
+    from throttlecrab_tpu.native import NativeKeyMap
+    from throttlecrab_tpu.tpu.kernel import finish_cur, pack_requests
+
+    slots, rank, is_last, em, tol, q, valid = nondegen_batch
+    st = make_table()
+    now = BASE + 5 * NS
+    st, cur2 = run(
+        st, *nondegen_batch, now, with_degen=False, compact="cur"
+    )
+    cur2 = np.asarray(cur2)
+    packed = pack_requests(slots, rank, is_last, em, tol, q, valid)
+    km = NativeKeyMap(16)
+    out = km.finish(packed, cur2, now)
+    al, rem, res, ret = finish_cur(cur2, em, tol, q, now)
+    np.testing.assert_array_equal(out[:, 0], al)
+    np.testing.assert_array_equal(out[:, 1], rem)
+    np.testing.assert_array_equal(out[:, 2], res)
+    np.testing.assert_array_equal(out[:, 3], ret)
+
+
+def test_fits_cur_wire_bounds():
+    from throttlecrab_tpu.tpu.kernel import fits_cur_wire
+
+    assert fits_cur_wire(np.array([0, (1 << 61) - 1], np.int64), (1 << 61) - 1)
+    assert not fits_cur_wire(np.array([1 << 61], np.int64), BASE)
+    assert not fits_cur_wire(np.array([1], np.int64), 1 << 61)
+    assert fits_cur_wire(np.array([], np.int64), BASE)  # empty batch

@@ -97,6 +97,10 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
     ]
+    lib.tk_finish.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
     lib.tk_prepare_batch.restype = ctypes.c_int64
     lib.tk_prepare_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
@@ -316,6 +320,44 @@ class NativeKeyMap:
             out.ctypes.data_as(ctypes.c_void_p),
         )
         return out, int(n_full)
+
+    def finish(
+        self,
+        packed: np.ndarray,
+        cur2: np.ndarray,
+        now_ns: int,
+        out: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Complete a compact="cur" device output into the exact 4-plane
+        wire values: i32[n, 4] rows (allowed, remaining, reset_after_secs,
+        retry_after_secs), reading emission/tolerance/quantity from the
+        same packed rows that built the launch.  Bit-exact twin of
+        kernel.finish_cur; see native/keymap.cpp tk_finish."""
+        from .tpu.kernel import PACK_WIDTH
+
+        packed = np.ascontiguousarray(packed, np.int32).reshape(
+            -1, PACK_WIDTH
+        )
+        cur2 = np.ascontiguousarray(cur2, np.int64).reshape(-1)
+        n = len(cur2)
+        if len(packed) != n:
+            raise ValueError("packed and cur2 row counts differ")
+        if out is None:
+            out = np.empty((n, 4), np.int32)
+        elif (
+            out.shape != (n, 4)
+            or out.dtype != np.int32
+            or not out.flags.c_contiguous
+        ):
+            raise ValueError("out must be a C-contiguous i32[n, 4] buffer")
+        self._lib.tk_finish(
+            packed.ctypes.data_as(ctypes.c_void_p),
+            cur2.ctypes.data_as(ctypes.c_void_p),
+            n,
+            now_ns,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out
 
     def prepare_batch(
         self,

@@ -152,6 +152,63 @@ def pack_requests(slots, rank, is_last, emission, tolerance, quantity, valid):
     return out
 
 
+def fits_cur_wire(tolerance, now_ns) -> bool:
+    """Certificate for the compact="cur" output mode (8 B/request).
+
+    The mode transmits one i64 per request: `cur * 2 + allowed`, where
+    `cur` is the request's observed TAT.  Exactness requires the shift to
+    never overflow: cur <= now + tol, so `now < 2**61 and tol < 2**61`
+    guarantees cur < 2**62 (and the certified fast path bounds cur below
+    by ~-(2**52): t0 >= now - max(emission, tolerance) with the segment
+    advance certified < 2**62).  tol >= 2**61 means a burst window over
+    73 years; now >= 2**61 is a wall clock past year 2043 — the engine
+    falls back to the 4-plane compact output for either.
+    """
+    import numpy as np
+
+    return bool(now_ns < (1 << 61)) and bool(
+        np.max(tolerance, initial=0) < (1 << 61)
+    )
+
+
+def finish_cur(cur2, emission, tolerance, quantity, now_ns):
+    """Host-side completion of the compact="cur" device output (numpy).
+
+    Reconstructs the exact 4-plane compact wire values — (allowed,
+    remaining, reset_after_secs, retry_after_secs), all i32 — from the
+    single i64-per-request device output.  Under the fits_cur_wire +
+    with_degen=False certificate every intermediate fits i64, so plain
+    arithmetic reproduces the device's saturating ops bit-for-bit on
+    every VALID lane.  (valid=False lanes are don't-care: the wire bit
+    carries the masked `allowed & valid`, so a padding lane whose
+    unmasked decision was "allowed" finishes with a nonzero retry where
+    the 4-plane compact output has 0 — all consumers mask those lanes.)
+    The C++ twin is native/keymap.cpp tk_finish (reads emission/
+    tolerance/quantity straight from the packed request rows).
+    """
+    import numpy as np
+
+    cur2 = np.asarray(cur2, np.int64)
+    allowed = (cur2 & 1) != 0
+    cur = cur2 >> 1  # arithmetic shift: exact for negative cur too
+    em = np.asarray(emission, np.int64)
+    tol = np.asarray(tolerance, np.int64)
+    inc = em * np.asarray(quantity, np.int64)
+    room = now_ns + tol - cur
+    remaining = np.maximum(
+        np.where(em > 0, room // np.where(em > 0, em, 1), 0), 0
+    )
+    reset = np.maximum(cur - now_ns + tol, 0)
+    retry = np.where(allowed, 0, np.maximum(cur + inc - tol - now_ns, 0))
+    i32max = _I32_MAX
+    return (
+        allowed.astype(np.int32),
+        np.minimum(remaining, i32max).astype(np.int32),
+        np.minimum(reset // 1_000_000_000, i32max).astype(np.int32),
+        np.minimum(retry // 1_000_000_000, i32max).astype(np.int32),
+    )
+
+
 def _unpack_requests(packed, now):
     """i32[B, PACK_WIDTH] → the _gcra_body batch tuple (device side)."""
 
@@ -306,6 +363,7 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False):
             tat_fin_main,
             compact,
             s_add, s_sub,
+            cur=cur_main,
         )
 
     degen = (inc == 0) | (tol == 0)
@@ -384,11 +442,20 @@ _NS_PER_SEC = 1_000_000_000
 def _finish(
     state, s, N, now, tol, allowed, remaining, reset_after,
     retry_after, wrote, tat_fin, compact,
-    s_add, s_sub,
+    s_add, s_sub, cur=None,
 ):
     """Write back the surviving state (one packed-row scatter) and stack the
     outputs.  `add_nn`/`sub_nn` are the caller's saturating ops (the
-    certified fast path passes the 2-op nonneg forms)."""
+    certified fast path passes the 2-op nonneg forms).
+
+    compact="cur" (certified path only — the degenerate views have no
+    single `cur`) emits ONE i64 per request, `cur * 2 + allowed`, and
+    leaves remaining/reset/retry to the host (kernel.finish_cur /
+    native tk_finish): XLA dead-code-eliminates their two emulated i64
+    divisions from the kernel, and the device→host fetch halves to
+    8 B/request — the launch-dominating cost through the serving tunnel
+    (docs/tpu-launch-profile.md).  Requires the fits_cur_wire
+    certificate so the shift cannot overflow."""
     ttl_fin = s_add(s_sub(tat_fin, now), tol)
     # expiry = now + ttl; ttl < 0 wraps to a ~584-year duration in the
     # reference, which we saturate to "never expires".
@@ -411,7 +478,10 @@ def _finish(
         )
 
     # One stacked output → one device-to-host fetch.
-    if compact:
+    if compact == "cur":
+        assert cur is not None, 'compact="cur" requires with_degen=False'
+        out = cur * 2 + allowed.astype(jnp.int64)
+    elif compact:
         out = jnp.stack(
             [
                 allowed.astype(jnp.int32),

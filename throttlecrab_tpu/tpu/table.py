@@ -133,10 +133,16 @@ class BucketTable:
         packed,
         now_ns,
         with_degen: bool = True,
-        compact: bool = False,
+        compact=False,
     ) -> jax.Array:
         """K stacked micro-batches from ONE packed i32[K, B, PACK_WIDTH]
         buffer (see kernel.pack_requests); `now_ns` is i64[K].
+
+        `compact` may be False (i64[K, 4, B] ns outputs), True (i32 wire
+        planes), or "cur" (i64[K, B], one `cur*2+allowed` word per
+        request for host-side completion via kernel.finish_cur / native
+        tk_finish — requires with_degen=False and the fits_cur_wire
+        certificate; 8 B/request, the cheapest device→host fetch).
 
         Unlike check_many this does NOT convert the output — it returns the
         device array untouched so a pipelined caller can defer the fetch
