@@ -133,6 +133,15 @@ def main() -> int:
                     help="capture an xprof trace of the timed region here")
     ap.add_argument("--legacy", action="store_true",
                     help="unpacked per-sub-batch resolve path")
+    ap.add_argument("--path", choices=("auto", "byid", "packed", "legacy"),
+                    default="auto",
+                    help="launch path: byid = 8 B/request words + "
+                         "device-resident parameter rows (default with "
+                         "the native keymap); packed = 36 B/request "
+                         "rows; legacy = per-sub-batch Python resolve")
+    ap.add_argument("--no-resident", action="store_true",
+                    help="skip the device-resident kernel-ceiling "
+                         "measurement")
     ap.add_argument("--pallas", action="store_true",
                     help="route table row gather/scatter through the "
                          "Pallas DMA kernels (tpu/pallas_ops.py)")
@@ -185,14 +194,22 @@ def main() -> int:
 
     limiter = TpuRateLimiter(capacity=1 << 21, keymap="auto", auto_grow=False)
     keymap_kind = type(limiter.keymap).__name__
-    packed_path = (
-        not args.legacy and hasattr(limiter.keymap, "assemble")
-    )
-    print(
-        f"keymap: {keymap_kind}  path: "
-        f"{'packed+pipelined' if packed_path else 'legacy'}",
-        file=sys.stderr,
-    )
+    path = args.path
+    if args.legacy:
+        path = "legacy"
+    if path == "auto":
+        path = (
+            "byid" if hasattr(limiter.keymap, "assemble_ids") else "legacy"
+        )
+    if path in ("byid", "packed") and not hasattr(
+        limiter.keymap, "assemble"
+    ):
+        print(
+            f"{path} path needs the native keymap; falling back to legacy",
+            file=sys.stderr,
+        )
+        path = "legacy"
+    print(f"keymap: {keymap_kind}  path: {path}", file=sys.stderr)
 
     # Per-key heterogeneous parameters (BASELINE config 3), derived
     # deterministically from the key id.
@@ -215,10 +232,16 @@ def main() -> int:
         "device": str(device),
         "platform": device.platform,
         "cpu_fallback_reason": fallback_reason,
-        "path": "packed" if packed_path else "legacy",
+        "path": path,
     }
 
-    if packed_path:
+    if path == "byid":
+        rate = run_byid(
+            limiter, keys, em_all, tol_all, rng, n_keys, depth,
+            args.pipe, warm_launches, timed_launches, args.profile,
+            not args.no_resident, extra,
+        )
+    elif path == "packed":
         rate = run_packed(
             limiter, keys, em_all, tol_all, rng, n_keys, depth,
             args.pipe, warm_launches, timed_launches, args.profile, extra,
@@ -246,21 +269,212 @@ def main() -> int:
     return 0
 
 
+def run_byid(
+    limiter, keys, em_all, tol_all, rng, n_keys, depth, pipe,
+    warm_launches, timed_launches, profile_dir, resident, extra,
+):
+    """The minimum-wire-bytes path: 8 B/request launch words + resident
+    parameter rows + 8 B/request compact="cur" outputs.
+
+    The tunnel to the TPU moves ~10-50 MB/s TOTAL, serialized across
+    h2d, compute and d2h (scripts/probe_duplex.py), so request bytes set
+    the throughput ceiling.  Per launch: one C++ call
+    (tk_assemble_ids) turns raw key ids into i64 words (id + segment
+    structure), the device gathers (slot, emission, tolerance) from
+    id rows uploaded once at setup, and the fetch returns one i64 per
+    request, finished to exact i32 wire values by C++ tk_finish_ids.
+    Fetches run on a thread pool — the relay serves concurrent reads
+    faster than serial blocking ones.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    km = limiter.keymap
+    table = limiter.table
+    per_launch = BATCH * depth
+
+    # Untimed setup: intern the key universe, resolve slots, upload the
+    # per-id parameter rows (config state, resident across launches).
+    km.intern(keys)
+    slots = km.resolve_all()
+    assert (slots >= 0).all(), "table full during setup"
+    id_rows = table.upload_id_rows(slots, em_all, tol_all)
+
+    def dispatch(ids, now_ns):
+        words, n_bad = km.assemble_ids(ids, BATCH)
+        assert not n_bad
+        out = table.check_many_byid(
+            id_rows,
+            words.reshape(depth, BATCH),
+            np.full(depth, now_ns, np.int64),
+            quantity=1,
+            with_degen=False,  # certified: qty=1, burst>1, emission>0,
+            compact="cur",     # tol>0, now/tol < 2**61 (fits_cur_wire)
+        )
+        return words, out, now_ns
+
+    def complete(words, out, now_ns):
+        """Fetch the 8 B/request device words and finish the exact i32
+        wire values (allowed, remaining, reset_s, retry_s) in C++."""
+        cur2 = np.asarray(out)
+        return km.finish_ids(words, em_all, tol_all, 1, cur2, now_ns)
+
+    # ---- populate: every key once, pipelined, no per-chunk blocking ------
+    t_pop = time.perf_counter()
+    pop_order = rng.permutation(n_keys).astype(np.int32)
+    pending = deque()
+    for start in range(0, n_keys, per_launch):
+        chunk = pop_order[start : start + per_launch]
+        ids = np.full(per_launch, -1, np.int32)
+        ids[: len(chunk)] = chunk
+        pending.append(dispatch(ids, T0)[1])
+        if len(pending) > pipe:
+            np.asarray(pending.popleft())
+    while pending:
+        np.asarray(pending.popleft())
+    extra["populate_s"] = round(time.perf_counter() - t_pop, 2)
+    print(
+        f"populated {len(limiter)} keys in {extra['populate_s']}s",
+        file=sys.stderr,
+    )
+
+    # ---- host-assembly-only throughput -----------------------------------
+    probe_ids = zipf_indices(rng, n_keys, per_launch).astype(np.int32)
+    km.assemble_ids(probe_ids, BATCH)
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        km.assemble_ids(probe_ids, BATCH)
+    host_rate = reps * per_launch / (time.perf_counter() - t0)
+    extra["host_assemble_slots_per_s"] = round(host_rate)
+    print(
+        f"host assembly alone: {host_rate / 1e6:.1f} M slots/s",
+        file=sys.stderr,
+    )
+
+    # ---- device-resident kernel ceiling ----------------------------------
+    # What the same kernel sustains when requests are already device-side
+    # (i.e. what a PCIe-attached deployment's device half would do): R
+    # launches over pre-staged word buffers, outputs reduced to one
+    # scalar on device, one fetch at the end.  Shows how much of the
+    # end-to-end gap is the tunnel link rather than the kernel.
+    if resident:
+        import jax
+
+        _sum = jax.jit(lambda x: x.sum())
+        R = 8
+        staged = []
+        for _ in range(R):
+            w, n_bad = km.assemble_ids(
+                zipf_indices(rng, n_keys, per_launch).astype(np.int32),
+                BATCH,
+            )
+            assert not n_bad
+            wd = jax.device_put(w.reshape(depth, BATCH))
+            np.asarray(_sum(wd))  # settle the upload (untimed)
+            staged.append(wd)
+        t0 = time.perf_counter()
+        checks = []
+        for r, wd in enumerate(staged):
+            out = table.check_many_byid(
+                id_rows, wd,
+                np.full(depth, T0 + r * 50_000_000, np.int64),
+                quantity=1, with_degen=False, compact="cur",
+            )
+            checks.append(_sum(out))
+        np.asarray(sum(checks))  # one scalar fetch drains everything
+        dt = time.perf_counter() - t0
+        extra["device_resident_decisions_per_s"] = round(
+            R * per_launch / dt
+        )
+        print(
+            f"device-resident kernel: {R * per_launch / dt / 1e6:.1f} "
+            f"M dec/s ({dt / R * 1e3:.1f} ms/launch)",
+            file=sys.stderr,
+        )
+
+    # ---- workload: Zipf-skewed launches, PIPE in flight ------------------
+    n_launches = warm_launches + timed_launches
+    draws = zipf_indices(rng, n_keys, n_launches * per_launch).astype(
+        np.int32
+    )
+    chunks = [
+        draws[i * per_launch : (i + 1) * per_launch]
+        for i in range(n_launches)
+    ]
+
+    pool = ThreadPoolExecutor(max_workers=3)
+    pending = deque()
+    for li in range(warm_launches):
+        pending.append(pool.submit(complete, *dispatch(
+            chunks[li], T0 + li * 50_000_000
+        )))
+    while pending:
+        pending.popleft().result()
+
+    import contextlib
+
+    if profile_dir:
+        from throttlecrab_tpu.tpu.profiling import trace
+
+        profiler = trace(profile_dir)
+        extra["trace_dir"] = profile_dir
+    else:
+        profiler = contextlib.nullcontext()
+
+    t_dispatch = {}
+    latencies = []
+    with profiler:
+        t_start = time.perf_counter()
+        for li in range(warm_launches, n_launches):
+            t_dispatch[li] = time.perf_counter()
+            pending.append(
+                (li, pool.submit(complete, *dispatch(
+                    chunks[li], T0 + li * 50_000_000
+                )))
+            )
+            if len(pending) > pipe:
+                j, fut = pending.popleft()
+                fut.result()
+                latencies.append(time.perf_counter() - t_dispatch[j])
+        while pending:
+            j, fut = pending.popleft()
+            fut.result()
+            latencies.append(time.perf_counter() - t_dispatch[j])
+        elapsed = time.perf_counter() - t_start
+    pool.shutdown()
+
+    decided = timed_launches * per_launch
+    lat = np.sort(np.asarray(latencies))
+    extra.update(
+        {
+            "elapsed_s": round(elapsed, 3),
+            "decisions": decided,
+            "fetch_latency_p50_ms": round(
+                float(lat[int(0.50 * len(lat))]) * 1e3, 3
+            ),
+            "fetch_latency_p99_ms": round(
+                float(lat[min(int(0.99 * len(lat)), len(lat) - 1)]) * 1e3, 3
+            ),
+            "launch_wall_ms": round(elapsed / timed_launches * 1e3, 3),
+        }
+    )
+    return decided / elapsed
+
+
 def run_packed(
     limiter, keys, em_all, tol_all, rng, n_keys, depth, pipe,
     warm_launches, timed_launches, profile_dir, extra,
 ):
-    """The round-4 path: C++ launch assembly + pipelined packed dispatch.
+    """36 B/request packed-row path (C++ tk_assemble + pipelined packed
+    dispatch + compact="cur" fetch).  Superseded as the default by
+    run_byid — kept as the A/B reference for the wire-bytes model and
+    for workloads whose parameters change per request.
 
-    Output side (the launch-dominating cost — the tunnel serves d2h at
-    ~10-50 MB/s, scripts/probe_d2h.py): the kernel's compact="cur" mode
-    returns ONE i64 per request (8 B instead of the 4-plane compact's
-    16 B), `copy_to_host_async` starts every transfer at dispatch time so
-    it overlaps later launches' compute, fetches run on a small thread
-    pool (the relay serves concurrent transfers ~4x faster than serial
-    blocking reads), and the exact i32 wire values are completed on the
-    host by C++ tk_finish at memory speed.
-    """
+    Note on fetch strategy: an earlier revision called
+    out.copy_to_host_async() at dispatch time; a hardware A/B showed
+    that HURTS on this relay (387 ms vs 264 ms per launch at depth 64 —
+    the early copy request serializes against the compute stream), so
+    both paths rely on the 3-thread fetch pool alone."""
     from concurrent.futures import ThreadPoolExecutor
 
     from throttlecrab_tpu.tpu.kernel import PACK_WIDTH as W
@@ -280,7 +494,6 @@ def run_packed(
             with_degen=False,  # certified: qty=1, burst>1, emission>0,
             compact="cur",     # tol>0, now/tol < 2**61 (fits_cur_wire)
         )
-        out.copy_to_host_async()  # start the d2h now, not at fetch time
         return packed, out, now_ns
 
     def complete(packed, out, now_ns):

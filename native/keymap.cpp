@@ -244,6 +244,29 @@ int64_t tk_lookup_insert_batch(
 
 constexpr int64_t PACK_W = 9;
 
+// Resolve an interned id to its slot: O(1) via the id→slot cache after
+// the first touch, else hash + probe (allocating on miss) and cache.
+// Returns -1 when the slot table is full.  Shared by tk_assemble,
+// tk_assemble_ids and tk_resolve_all so the caching rule cannot drift.
+static int32_t resolve_interned(KeyMap* m, int64_t id) {
+    int32_t slot = m->id_slot[id];
+    if (slot >= 0) return slot;
+    const char* key = m->id_arena.data() + m->id_off[id];
+    const int64_t len = m->id_off[id + 1] - m->id_off[id];
+    bool is_full = false;
+    Entry* e = m->find_or_insert(key, len, &is_full);
+    if (is_full) return -1;
+    slot = e->slot;
+    // Cache only an unclaimed slot: two interned ids with identical key
+    // bytes share a slot, and the reverse map can hold just one of them
+    // — the other stays slow-path.
+    if (m->slot_id[slot] < 0) {
+        m->slot_id[slot] = static_cast<int32_t>(id);
+        m->id_slot[id] = slot;
+    }
+    return slot;
+}
+
 // Register `n` keys; ids are assigned sequentially.  Returns the first id.
 int64_t tk_intern_keys(void* h, const char* keys, const int64_t* offsets,
                        int64_t n) {
@@ -288,28 +311,12 @@ int64_t tk_assemble(void* h, const int32_t* ids, int64_t total, int64_t batch,
                 if (id >= n_ids) full++;  // un-interned id: surface it
                 continue;
             }
-            int32_t slot = m->id_slot[id];
+            const int32_t slot = resolve_interned(m, id);
             if (slot < 0) {
-                // Slow path: hash + probe (first touch after intern or
-                // after a sweep freed the slot), then cache.
-                const char* key = m->id_arena.data() + m->id_off[id];
-                const int64_t len = m->id_off[id + 1] - m->id_off[id];
-                bool is_full = false;
-                Entry* e = m->find_or_insert(key, len, &is_full);
-                if (is_full) {
-                    w[0] = -1;
-                    for (int j = 1; j < PACK_W; j++) w[j] = 0;
-                    full++;
-                    continue;
-                }
-                slot = e->slot;
-                // Cache only an unclaimed slot: two interned ids with
-                // identical key bytes share a slot, and the reverse map
-                // can hold just one of them — the other stays slow-path.
-                if (m->slot_id[slot] < 0) {
-                    m->slot_id[slot] = static_cast<int32_t>(id);
-                    m->id_slot[id] = slot;
-                }
+                w[0] = -1;
+                for (int j = 1; j < PACK_W; j++) w[j] = 0;
+                full++;
+                continue;
             }
             w[0] = slot;
             w[2] = 3;  // is_last | valid
@@ -337,6 +344,129 @@ int64_t tk_assemble(void* h, const int32_t* ids, int64_t total, int64_t batch,
     return full;
 }
 
+// ---------------------------------------------------------------------
+// By-id launch assembly: the minimum-bytes request path.
+//
+// The serving tunnel moves ~10-50 MB/s TOTAL (both directions, serialized
+// — scripts/probe_d2h.py / probe_duplex.py), so the 36 B/request packed
+// row is the launch-dominating payload.  When the key universe is
+// interned and its parameter rows are resident on the DEVICE
+// (tpu/table.py upload_id_rows), a request needs only its id plus the
+// duplicate-segment structure: ONE i64 word
+//   low 32 bits: id | high 32: rank(14) | is_last<<14 | valid<<15
+// — 8 B/request, 4.5x less than the packed row.  The device gathers
+// (slot, emission, tolerance) from the resident rows by id.
+//
+// Contract (the bench/serving caller certifies): every id interned, ids
+// canonical enough that ids sharing a SLOT share parameters (segments
+// are tracked per slot, exactly like tk_assemble, so duplicate key
+// BYTES under different ids still serialize correctly).
+
+// Resolve every interned id to a slot (allocating on miss) and fill the
+// caller's id→slot array — the host half of the device id-row upload.
+// Returns the number of ids that could not get a slot (table full);
+// their slots_out entry is -1.
+int64_t tk_resolve_all(void* h, int32_t* slots_out) {
+    KeyMap* m = static_cast<KeyMap*>(h);
+    const int64_t n_ids = static_cast<int64_t>(m->id_off.size()) - 1;
+    int64_t failed = 0;
+    for (int64_t id = 0; id < n_ids; id++) {
+        const int32_t slot = resolve_interned(m, id);
+        slots_out[id] = slot;
+        if (slot < 0) failed++;
+    }
+    return failed;
+}
+
+// Build the i64 request words for a launch of `total` requests
+// (micro-batches of `batch`) straight from an id array.  ids < 0 are
+// padding (valid=0).  Returns the number of requests dropped (id never
+// interned / table full — written invalid so the caller's n_bad check
+// catches a forgotten intern or resolve).
+int64_t tk_assemble_ids(void* h, const int32_t* ids, int64_t total,
+                        int64_t batch, int64_t* out) {
+    KeyMap* m = static_cast<KeyMap*>(h);
+    const int64_t n_ids = static_cast<int64_t>(m->id_off.size()) - 1;
+    int64_t bad = 0;
+    for (int64_t base = 0; base < total; base += batch) {
+        m->batch_stamp++;
+        const uint64_t stamp = m->batch_stamp;
+        const int64_t end = base + batch < total ? base + batch : total;
+        for (int64_t i = base; i < end; i++) {
+            const int64_t id = ids[i];
+            if (id < 0 || id >= n_ids) {
+                out[i] = 0;  // valid=0
+                if (id >= n_ids) bad++;
+                continue;
+            }
+            const int32_t slot = resolve_interned(m, id);
+            if (slot < 0) {
+                out[i] = 0;
+                bad++;
+                continue;
+            }
+            int64_t meta;
+            if (m->slot_stamp[slot] == stamp) {
+                const int32_t rank = m->slot_count[slot]++;
+                // Clear the previous occurrence's is_last bit.
+                out[m->slot_last_pos[slot]] &=
+                    ~(static_cast<int64_t>(1) << 46);
+                m->slot_last_pos[slot] = static_cast<int32_t>(i);
+                meta = rank | (1 << 14) | (1 << 15);
+            } else {
+                m->slot_stamp[slot] = stamp;
+                m->slot_count[slot] = 1;
+                m->slot_last_pos[slot] = static_cast<int32_t>(i);
+                meta = (1 << 14) | (1 << 15);
+            }
+            out[i] = (meta << 32) | static_cast<uint32_t>(id);
+        }
+    }
+    return bad;
+}
+
+// One request's wire completion from its `cur*2+allowed` word: the exact
+// arithmetic shared by tk_finish (packed rows) and tk_finish_ids (by-id
+// tables) so the two paths cannot drift.  Under the fits_cur_wire +
+// with_degen=False certificate (kernel.py) no intermediate leaves i64.
+static inline void finish_one(int64_t em, int64_t tol, int64_t qty,
+                              int64_t c2, int64_t now, int32_t* o) {
+    constexpr int64_t I32MAX = 2147483647ll;
+    constexpr int64_t NSEC = 1000000000ll;
+    const int64_t allowed = c2 & 1;
+    const int64_t cur = c2 >> 1;  // arithmetic: exact for negatives
+    const int64_t room = now + tol - cur;
+    int64_t remaining = em > 0 ? room / em : 0;
+    if (remaining < 0) remaining = 0;
+    int64_t reset = cur - now + tol;
+    if (reset < 0) reset = 0;
+    int64_t retry = allowed ? 0 : cur + em * qty - tol - now;
+    if (retry < 0) retry = 0;
+    o[0] = static_cast<int32_t>(allowed);
+    o[1] = static_cast<int32_t>(remaining < I32MAX ? remaining : I32MAX);
+    const int64_t reset_s = reset / NSEC;
+    o[2] = static_cast<int32_t>(reset_s < I32MAX ? reset_s : I32MAX);
+    const int64_t retry_s = retry / NSEC;
+    o[3] = static_cast<int32_t>(retry_s < I32MAX ? retry_s : I32MAX);
+}
+
+// tk_finish for the by-id path: emission/tolerance come from the host
+// parameter tables indexed by the id in each request word; quantity is
+// the launch-uniform scalar.
+void tk_finish_ids(const int64_t* words, const int64_t* em_by_id,
+                   const int64_t* tol_by_id, int64_t quantity,
+                   const int64_t* cur2, int64_t n, int64_t now,
+                   int32_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        const int64_t word = words[i];
+        const int64_t id = static_cast<uint32_t>(word);
+        const bool valid = (word >> 47) & 1;
+        const int64_t em = valid ? em_by_id[id] : 0;
+        const int64_t tol = valid ? tol_by_id[id] : 0;
+        finish_one(em, tol, quantity, cur2[i], now, out + i * 4);
+    }
+}
+
 // Host-side completion of the kernel's compact="cur" device output:
 // reconstruct the exact 4-plane wire values (allowed, remaining,
 // reset_after_secs, retry_after_secs — i32, saturated exactly like the
@@ -349,8 +479,6 @@ int64_t tk_assemble(void* h, const int32_t* ids, int64_t total, int64_t batch,
 // the launch's device→host bytes AND removes emulated 64-bit VPU work.
 void tk_finish(const int32_t* packed, const int64_t* cur2, int64_t n,
                int64_t now, int32_t* out) {
-    constexpr int64_t I32MAX = 2147483647ll;
-    constexpr int64_t NSEC = 1000000000ll;
     for (int64_t i = 0; i < n; i++) {
         const int32_t* w = packed + i * PACK_W;
         const int64_t em =
@@ -362,23 +490,7 @@ void tk_finish(const int32_t* packed, const int64_t* cur2, int64_t n,
         const int64_t qty =
             (static_cast<int64_t>(w[8]) << 32) |
             static_cast<uint32_t>(w[7]);
-        const int64_t c2 = cur2[i];
-        const int64_t allowed = c2 & 1;
-        const int64_t cur = c2 >> 1;  // arithmetic: exact for negatives
-        const int64_t room = now + tol - cur;
-        int64_t remaining = em > 0 ? room / em : 0;
-        if (remaining < 0) remaining = 0;
-        int64_t reset = cur - now + tol;
-        if (reset < 0) reset = 0;
-        int64_t retry = allowed ? 0 : cur + em * qty - tol - now;
-        if (retry < 0) retry = 0;
-        int32_t* o = out + i * 4;
-        o[0] = static_cast<int32_t>(allowed);
-        o[1] = static_cast<int32_t>(remaining < I32MAX ? remaining : I32MAX);
-        const int64_t reset_s = reset / NSEC;
-        o[2] = static_cast<int32_t>(reset_s < I32MAX ? reset_s : I32MAX);
-        const int64_t retry_s = retry / NSEC;
-        o[3] = static_cast<int32_t>(retry_s < I32MAX ? retry_s : I32MAX);
+        finish_one(em, tol, qty, cur2[i], now, out + i * 4);
     }
 }
 

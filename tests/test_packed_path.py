@@ -210,3 +210,115 @@ def test_native_assemble_multiple_intern_calls():
     # Same keys through resolve agree on slots.
     slots, _, _, _ = km.resolve([b"z", b"x", b"y"], np.ones(3, bool))
     np.testing.assert_array_equal(packed[:, 0], slots)
+
+
+class TestByIdPath:
+    """The 8 B/request by-id launch path (tk_assemble_ids +
+    gcra_scan_byid + tk_finish_ids) must match the packed path exactly."""
+
+    @pytest.fixture
+    def native_km(self):
+        from throttlecrab_tpu.native import toolchain_available
+
+        if not toolchain_available():
+            pytest.skip("no C++ toolchain")
+        from throttlecrab_tpu.native import NativeKeyMap
+
+        return NativeKeyMap(256)
+
+    def test_words_match_packed_rows(self, native_km):
+        """assemble_ids emits the same slot/rank/is_last/valid structure
+        as assemble, in 8 bytes instead of 36."""
+        km = native_km
+        n = 64
+        km.intern([b"key:%d" % i for i in range(n)])
+        em = np.arange(1, n + 1, dtype=np.int64) * 1000
+        tol = np.arange(1, n + 1, dtype=np.int64) * 7000
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, n, 96).astype(np.int32)
+        ids[5] = -1  # padding
+        packed, n_full = km.assemble(ids, 32, em, tol, 1)
+        assert n_full == 0
+        words, n_bad = km.assemble_ids(ids, 32)
+        assert n_bad == 0
+        slots = km.resolve_all()
+
+        meta = words >> 32
+        w_rank = (meta & 0x3FFF).astype(np.int32)
+        w_last = (meta & (1 << 14)) != 0
+        w_valid = (meta & (1 << 15)) != 0
+        w_id = (words & 0xFFFFFFFF).astype(np.int64)
+
+        p_valid = (packed[:, 2] & 2) != 0
+        np.testing.assert_array_equal(w_valid, p_valid)
+        np.testing.assert_array_equal(w_rank[w_valid], packed[p_valid, 1])
+        np.testing.assert_array_equal(
+            w_last[w_valid], (packed[p_valid, 2] & 1) != 0
+        )
+        # The id in each word resolves to the packed row's slot.
+        np.testing.assert_array_equal(
+            slots[w_id[w_valid]], packed[p_valid, 0]
+        )
+
+    def test_end_to_end_matches_packed(self, native_km):
+        """Same workload through check_many_byid + finish_ids and through
+        check_many_packed + finish: identical wire values and identical
+        table state."""
+        from throttlecrab_tpu.tpu.kernel import PACK_WIDTH
+        from throttlecrab_tpu.tpu.table import BucketTable
+
+        km = native_km
+        n, B, K = 40, 32, 4
+        km.intern([b"k:%d" % i for i in range(n)])
+        em = (np.arange(n, dtype=np.int64) % 7 + 1) * 250_000_000
+        tol = em * (np.arange(n, dtype=np.int64) % 5 + 2)
+        rng = np.random.RandomState(11)
+        ids = rng.randint(0, n, K * B).astype(np.int32)
+        now = np.full(K, 1_753_000_000_000_000_000, np.int64)
+
+        packed, n_full = km.assemble(ids, B, em, tol, 1)
+        assert not n_full
+        words, n_bad = km.assemble_ids(ids, B)
+        assert not n_bad
+
+        t1 = BucketTable(128)
+        out_p = np.asarray(
+            t1.check_many_packed(
+                packed.reshape(K, B, PACK_WIDTH), now,
+                with_degen=False, compact="cur",
+            )
+        )
+        wire_p = km.finish(packed, out_p.reshape(-1), int(now[0]))
+
+        t2 = BucketTable(128)
+        rows = t2.upload_id_rows(km.resolve_all(), em, tol)
+        out_w = np.asarray(
+            t2.check_many_byid(
+                rows, words.reshape(K, B), now,
+                quantity=1, with_degen=False, compact="cur",
+            )
+        )
+        wire_w = km.finish_ids(
+            words, em, tol, 1, out_w.reshape(-1), int(now[0])
+        )
+
+        np.testing.assert_array_equal(out_p, out_w)
+        np.testing.assert_array_equal(wire_p, wire_w)
+        np.testing.assert_array_equal(
+            np.asarray(t1.state)[:64], np.asarray(t2.state)[:64]
+        )
+
+    def test_assemble_ids_rejects_oversized_batch(self, native_km):
+        with pytest.raises(ValueError):
+            native_km.assemble_ids(np.zeros(4, np.int32), 1 << 15)
+
+    def test_uninterned_id_reported_bad(self, native_km):
+        km = native_km
+        km.intern([b"a", b"b"])
+        words, n_bad = km.assemble_ids(
+            np.array([0, 1, 7, -1], np.int32), 4
+        )
+        assert n_bad == 1
+        meta = words >> 32
+        valid = (meta & (1 << 15)) != 0
+        np.testing.assert_array_equal(valid, [True, True, False, False])

@@ -101,6 +101,17 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p,
     ]
+    lib.tk_resolve_all.restype = ctypes.c_int64
+    lib.tk_resolve_all.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.tk_assemble_ids.restype = ctypes.c_int64
+    lib.tk_assemble_ids.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
+    lib.tk_finish_ids.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+    ]
     lib.tk_prepare_batch.restype = ctypes.c_int64
     lib.tk_prepare_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
@@ -320,6 +331,93 @@ class NativeKeyMap:
             out.ctypes.data_as(ctypes.c_void_p),
         )
         return out, int(n_full)
+
+    def resolve_all(self) -> np.ndarray:
+        """Resolve every interned id to a slot (allocating on miss);
+        returns the id→slot array (i32[n_ids], -1 where the table is
+        full).  The host half of BucketTable.upload_id_rows."""
+        n_ids = getattr(self, "_n_ids", 0)
+        slots = np.empty(n_ids, np.int32)
+        self._lib.tk_resolve_all(
+            self._h, slots.ctypes.data_as(ctypes.c_void_p)
+        )
+        return slots
+
+    def assemble_ids(
+        self,
+        ids: np.ndarray,
+        batch: int,
+        out: Optional[np.ndarray] = None,
+    ):
+        """Build the 8-byte-per-request launch words (see kernel
+        gcra_scan_byid) straight from interned key ids: low 32 bits id,
+        high 32 rank/is_last/valid, duplicate segments tracked per slot
+        exactly like assemble().  Returns (words i64[total], n_bad)."""
+        if not 0 < batch <= 1 << 14:
+            raise ValueError("batch must be in (0, 16384] (14-bit rank)")
+        ids = np.ascontiguousarray(ids, np.int32)
+        total = len(ids)
+        if out is None:
+            out = np.empty(total, np.int64)
+        elif (
+            out.shape != (total,)
+            or out.dtype != np.int64
+            or not out.flags.c_contiguous
+        ):
+            raise ValueError("out must be a C-contiguous i64[total] buffer")
+        n_bad = self._lib.tk_assemble_ids(
+            self._h,
+            ids.ctypes.data_as(ctypes.c_void_p),
+            total,
+            batch,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out, int(n_bad)
+
+    def finish_ids(
+        self,
+        words: np.ndarray,
+        em_by_id: np.ndarray,
+        tol_by_id: np.ndarray,
+        quantity: int,
+        cur2: np.ndarray,
+        now_ns: int,
+        out: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """tk_finish for the by-id path: parameters come from the host
+        tables indexed by each request word's id.  Returns i32[n, 4]
+        (allowed, remaining, reset_after_secs, retry_after_secs)."""
+        words = np.ascontiguousarray(words, np.int64).reshape(-1)
+        cur2 = np.ascontiguousarray(cur2, np.int64).reshape(-1)
+        n = len(cur2)
+        if len(words) != n:
+            raise ValueError("words and cur2 row counts differ")
+        em_by_id = np.ascontiguousarray(em_by_id, np.int64)
+        tol_by_id = np.ascontiguousarray(tol_by_id, np.int64)
+        n_ids = getattr(self, "_n_ids", 0)
+        if len(em_by_id) < n_ids or len(tol_by_id) < n_ids:
+            raise ValueError(
+                f"parameter tables must cover all {n_ids} interned ids"
+            )
+        if out is None:
+            out = np.empty((n, 4), np.int32)
+        elif (
+            out.shape != (n, 4)
+            or out.dtype != np.int32
+            or not out.flags.c_contiguous
+        ):
+            raise ValueError("out must be a C-contiguous i32[n, 4] buffer")
+        self._lib.tk_finish_ids(
+            words.ctypes.data_as(ctypes.c_void_p),
+            em_by_id.ctypes.data_as(ctypes.c_void_p),
+            tol_by_id.ctypes.data_as(ctypes.c_void_p),
+            quantity,
+            cur2.ctypes.data_as(ctypes.c_void_p),
+            n,
+            now_ns,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out
 
     def finish(
         self,

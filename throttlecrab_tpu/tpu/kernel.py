@@ -622,6 +622,85 @@ def gcra_scan_packed(state, packed, now, *, with_degen=True, compact=False):
     return jax.lax.scan(step, state, (packed, now.astype(jnp.int64)))
 
 
+# By-id request words (native/keymap.cpp tk_assemble_ids):
+#   low 32 bits: key id | high 32: rank(14) | is_last<<14 | valid<<15
+# The device gathers (slot, emission, tolerance) from resident id rows —
+# an i32[n_ids, 8] table built by BucketTable.upload_id_rows — so a
+# request costs 8 bytes host→device instead of the 36-byte packed row.
+# The tunnel moves 10-50 MB/s total, serialized across h2d/compute/d2h
+# (scripts/probe_duplex.py), so request bytes are the throughput ceiling.
+IDROW_WIDTH = 8
+
+
+def pack_id_rows(slots, emission, tolerance):
+    """Host-side build of the resident by-id parameter rows:
+    i32[n, IDROW_WIDTH] = [slot, em_lo, em_hi, tol_lo, tol_hi, 0, 0, 0].
+    """
+    import numpy as np
+
+    n = len(slots)
+    rows = np.zeros((n, IDROW_WIDTH), np.int32)
+    rows[:, 0] = slots
+    for base, arr in ((1, emission), (3, tolerance)):
+        a = np.asarray(arr, np.int64)
+        rows[:, base] = (a & _U32).astype(np.uint32).view(np.int32)
+        rows[:, base + 1] = (a >> 32).astype(np.int32)
+    return rows
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0,),
+    static_argnames=("with_degen", "compact"),
+)
+def gcra_scan_byid(
+    state, id_rows, words, now, quantity, *, with_degen=True, compact=False,
+):
+    """gcra_scan fed by 8-byte request words + resident id rows.
+
+    Args:
+      state:    i32[N, 4] packed table rows (donated).
+      id_rows:  i32[n_ids, IDROW_WIDTH] resident parameter rows (NOT
+                donated — reused launch after launch; see pack_id_rows).
+      words:    i64[K, B] request words (tk_assemble_ids layout).
+      now:      i64[K] per-sub-batch timestamps.
+      quantity: i64 scalar, uniform per launch (the bench/serving caller
+                certifies uniformity before taking this path).
+
+    Semantically identical to gcra_scan on the expanded arrays; requests
+    whose valid bit is 0 are padding.  Returns (state, out) with `out`
+    per the `compact` mode.
+    """
+    n_ids = id_rows.shape[0]
+
+    def step(state, kb):
+        w, now_k = kb
+        idx = jnp.clip((w & _U32).astype(jnp.int32), 0, n_ids - 1)
+        rows = id_rows[idx]
+
+        def join(lo, hi):
+            return (hi.astype(jnp.int64) << 32) | (
+                lo.astype(jnp.int64) & _U32
+            )
+
+        meta = w >> 32
+        batch = (
+            rows[:, 0],                                   # slots
+            meta & 0x3FFF,                                # rank (i64)
+            (meta & (1 << 14)) != 0,                      # is_last
+            join(rows[:, 1], rows[:, 2]),                 # emission
+            join(rows[:, 3], rows[:, 4]),                 # tolerance
+            jnp.full(w.shape, quantity, jnp.int64),       # quantity
+            (meta & (1 << 15)) != 0,                      # valid
+            now_k,
+        )
+        return _gcra_body(
+            state, batch, with_degen=with_degen, compact=compact
+        )
+
+    return jax.lax.scan(step, state, (words, now.astype(jnp.int64)))
+
+
 @partial(jax.jit, donate_argnums=(1,), static_argnames=("capacity",))
 def sweep_expired(now, state, capacity):
     """Cleanup-as-compaction: vacate every expired slot, report which.

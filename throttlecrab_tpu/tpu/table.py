@@ -24,7 +24,9 @@ from .kernel import (
     EMPTY_EXPIRY,
     gcra_batch,
     gcra_scan,
+    gcra_scan_byid,
     gcra_scan_packed,
+    pack_id_rows,
     pack_state,
     sweep_expired,
     unpack_state,
@@ -157,6 +159,46 @@ class BucketTable:
             if isinstance(packed, jax.Array)
             else jnp.asarray(packed, jnp.int32),
             jnp.asarray(now_ns, jnp.int64),
+            with_degen=with_degen,
+            compact=compact,
+        )
+        return out
+
+    def upload_id_rows(
+        self, slots, emission, tolerance
+    ) -> jax.Array:
+        """Build and upload the by-id parameter rows for check_many_byid:
+        i32[n_ids, IDROW_WIDTH] = [slot, em_lo/hi, tol_lo/hi, pad].  One
+        untimed setup transfer; the rows then stay device-resident so a
+        request costs 8 bytes on the wire instead of the 36-byte packed
+        row (the tunnel's ~10-50 MB/s serialized link is the launch
+        throughput ceiling — docs/tpu-launch-profile.md).  Re-upload
+        after a sweep or growth remaps slots."""
+        rows = pack_id_rows(slots, emission, tolerance)
+        return jax.device_put(rows, self.device)
+
+    def check_many_byid(
+        self,
+        id_rows: jax.Array,
+        words,
+        now_ns,
+        quantity: int = 1,
+        with_degen: bool = True,
+        compact=False,
+    ) -> jax.Array:
+        """K stacked micro-batches of 8-byte request words (i64[K, B],
+        tk_assemble_ids layout) against resident `id_rows`.  `quantity`
+        is launch-uniform.  Returns the device output per `compact`
+        (see check_many_packed) without fetching."""
+        assert words.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
+        self.state, out = gcra_scan_byid(
+            self.state,
+            id_rows,
+            words
+            if isinstance(words, jax.Array)
+            else jnp.asarray(words, jnp.int64),
+            jnp.asarray(now_ns, jnp.int64),
+            quantity,
             with_degen=with_degen,
             compact=compact,
         )
