@@ -514,6 +514,7 @@ void tk_finish(const int32_t* packed, const int64_t* cur2, int64_t n,
 constexpr int64_t TK_PREP_DEGEN = 1;     // needs the exact kernel path
 constexpr int64_t TK_PREP_CONFLICT = 2;  // same key, different params
 constexpr int64_t TK_PREP_FULL = 4;      // slot table full
+constexpr int64_t TK_PREP_BIGTOL = 8;    // tol >= 2^61: no "cur" wire mode
 
 constexpr uint8_t STATUS_OK = 0;
 constexpr uint8_t STATUS_NEGATIVE_QUANTITY = 1;
@@ -569,6 +570,11 @@ int64_t tk_prepare_batch(void* h, const char* keys, const int64_t* offsets,
                 * 65536.0
             >= 4611686018427387904.0)  // 2^62
             flags |= TK_PREP_DEGEN;
+        // fits_cur_wire half of the compact="cur" certificate (kernel.py):
+        // tol >= 2^61 would overflow the cur*2+allowed wire word.  (The
+        // now < 2^61 half is the caller's, since `now` arrives at launch
+        // time.)
+        if (tol >= (int64_t(1) << 61)) flags |= TK_PREP_BIGTOL;
 
         const char* key = keys + offsets[i];
         const int64_t len = offsets[i + 1] - offsets[i];

@@ -217,6 +217,7 @@ def wire_build_error() -> Optional[str]:
 PREP_DEGEN = 1
 PREP_CONFLICT = 2
 PREP_FULL = 4
+PREP_BIGTOL = 8  # tol >= 2^61: compact="cur" wire word would overflow
 
 
 class NativeKeyMap:

@@ -308,21 +308,33 @@ class _PendingLaunch:
     the device is already executing (or queued behind the table-state
     dependency chain) by the time the caller holds this."""
 
-    def __init__(self, out_dev, prepared, valid_s, wire) -> None:
+    def __init__(self, out_dev, prepared, valid_s, wire, cur=False) -> None:
         self._out_dev = out_dev
         self._prepared = prepared
         self._valid_s = valid_s
         self._wire = wire
+        self._cur = cur
 
     def fetch(self) -> list:
         out = np.asarray(self._out_dev)
         wire = self._wire
+        if self._cur:
+            from .kernel import finish_cur
         results = []
         for j, (n, slots, rank, is_last, emission, tolerance, quantity,
                 valid, now_ns, max_burst, status) in enumerate(
             self._prepared
         ):
-            o = out[j, :, :n]
+            if self._cur:
+                # 8 B/request "cur" fetch, host-finished to the exact
+                # i32 wire planes (kernel.finish_cur).
+                o = np.stack(
+                    finish_cur(
+                        out[j, :n], emission, tolerance, quantity, now_ns
+                    )
+                )
+            else:
+                o = out[j, :, :n]
             mask = self._valid_s[j, :n]
             fields = dict(
                 allowed=(o[0] != 0) & mask,
@@ -351,19 +363,33 @@ class _PendingLaunch:
 
 class _PendingWireLaunch:
     """In-flight launch from dispatch_wire_window; .fetch() distributes
-    the compact device output into per-frame WireBatchResults."""
+    the compact device output into per-frame WireBatchResults.
 
-    def __init__(self, out_dev, prepared) -> None:
+    Two device output formats (limiter picks at dispatch):
+      - 4-plane compact i32[K, 4, B] (`finish=None`), or
+      - compact="cur" i64[K, B] — 8 B/request instead of 16 through the
+        serving tunnel — completed to the exact i32 wire values by the
+        native keymap's tk_finish (`finish` is the keymap.finish bound
+        method; requires the certified non-degenerate path and
+        fits_cur_wire, which the limiter checked before dispatch).
+    """
+
+    def __init__(self, out_dev, prepared, finish=None, now_ns=0) -> None:
         self._out_dev = out_dev
         self._prepared = prepared
+        self._finish = finish
+        self._now_ns = now_ns
 
     def fetch(self) -> list:
         out = np.asarray(self._out_dev)
         results = []
         for j, (packed, status, params) in enumerate(self._prepared):
             n = len(status)
-            o = out[j, :, :n]
             valid = (packed[:, 2] & 2) != 0
+            if self._finish is not None:
+                o = self._finish(packed, out[j, :n], self._now_ns).T
+            else:
+                o = out[j, :, :n]
             results.append(
                 WireBatchResult(
                     allowed=(o[0] != 0) & valid,
@@ -634,16 +660,26 @@ class TpuRateLimiter(ScalarCompatMixin):
         # tunnel charges ~6 ms per transfer *call*, so eight per-array
         # transfers per launch would cost more than the device work
         # (docs/tpu-launch-profile.md).
-        from .kernel import pack_requests
+        from .kernel import fits_cur_wire, pack_requests
 
         packed = pack_requests(
             slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s
         )
+        # The 8 B/request "cur" output halves the fetch whenever the
+        # certified fast path applies and the fits_cur_wire bound holds
+        # (now/tol < 2^61); finished to identical wire values on the
+        # host in _PendingLaunch.fetch.
+        use_cur = (
+            wire
+            and not any_degen
+            and fits_cur_wire(tol_s, int(now_s.max(initial=0)))
+        )
         out_dev = self.table.check_many_packed(
             packed, now_s,
-            with_degen=not wire or any_degen, compact=wire,
+            with_degen=not wire or any_degen,
+            compact="cur" if use_cur else wire,
         )
-        return _PendingLaunch(out_dev, prepared, valid_s, wire)
+        return _PendingLaunch(out_dev, prepared, valid_s, wire, cur=use_cur)
 
     # ------------------------------------------------------------------ #
 
@@ -669,22 +705,34 @@ class TpuRateLimiter(ScalarCompatMixin):
                 "batch now_ns must be non-negative; apply "
                 "normalize_now_ns per request for pre-epoch clocks"
             )
-        from ..native import PREP_CONFLICT, PREP_DEGEN, PREP_FULL
+        from ..native import PREP_BIGTOL, PREP_CONFLICT, PREP_DEGEN, PREP_FULL
 
         prepared = []
         width = self.MIN_PAD
         any_degen = False
+        any_bigtol = False
         for blob, offsets, params in frames:
             packed, status, flags = km.prepare_batch(blob, offsets, params)
             if flags & (PREP_CONFLICT | PREP_FULL):
                 return None
             any_degen = any_degen or bool(flags & PREP_DEGEN)
+            any_bigtol = any_bigtol or bool(flags & PREP_BIGTOL)
             prepared.append((packed, status, params))
             n = len(status)
             width = max(width, 1 << max(n - 1, 0).bit_length())
 
         from .kernel import PACK_WIDTH
 
+        # 8 B/request "cur" output (host-finished by C++ tk_finish) when
+        # the certified fast path and the fits_cur_wire bound both hold;
+        # else the 4-plane compact i32 output.  Same exact wire values
+        # either way (tests/test_wire_path.py pins the equivalence).
+        use_cur = (
+            not any_degen
+            and not any_bigtol
+            and now_ns < (1 << 61)
+            and hasattr(km, "finish")
+        )
         K = len(prepared)
         K_pad = 1 << max(K - 1, 0).bit_length()
         stack = np.zeros((K_pad, width, PACK_WIDTH), np.int32)
@@ -694,8 +742,12 @@ class TpuRateLimiter(ScalarCompatMixin):
             stack,
             np.full(K_pad, now_ns, np.int64),
             with_degen=any_degen,
-            compact=True,
+            compact="cur" if use_cur else True,
         )
+        if use_cur:
+            return _PendingWireLaunch(
+                out_dev, prepared, finish=km.finish, now_ns=now_ns
+            )
         return _PendingWireLaunch(out_dev, prepared)
 
     def sweep(self, now_ns: int) -> int:
