@@ -1,23 +1,29 @@
 """Pallas TPU kernels for the bucket-table row gather/scatter.
 
-The CPU kernel ablation (scripts/probe_kernel_ablation.py, round 4) puts
-~85% of the decision kernel's time in the random-row gather + scatter
-over the [N, 4] i32 table; the GCRA math itself is cheap VPU work.  XLA
-lowers a 4096-row random scatter conservatively, so these kernels do the
-memory movement explicitly: a ring of small async DMAs (one 16-byte row
-each) that overlap address latency instead of serializing on it, per
-SURVEY §7.2 step 2's "drop to Pallas only if the gather/scatter
-dominates" — which the ablation showed it does.
+STATUS: NO-GO on hardware — kept for the record and the interpret-mode
+tests.  The round-4 hardware evidence (docs/tpu-launch-profile.md):
 
-The i64 GCRA arithmetic stays in XLA (TPU vector lanes are 32-bit;
-reimplementing 64-bit div/mul in-kernel would be all risk for no gain) —
-the kernels move rows, XLA fuses the math between them.
+1. The CPU ablation that motivated these kernels (~85% of kernel time in
+   row movement) does NOT transfer to the TPU: the on-device ablation
+   measures `elementwise` (no gather, no scatter) within noise of the
+   full body — on v5e the batch is latency-bound on the VPU pipeline,
+   not on the row movement XLA emits.
+2. The device-resident kernel already sustains ~10 M decisions/s; the
+   end-to-end ceiling is the serving tunnel's ~10-50 MB/s link, which no
+   kernel change can move.
+3. The DMA-ring kernels themselves lower only after pinning every loop
+   scalar to i32 (jax x64 makes Mosaic's scalar conversion recurse), and
+   then the remote Mosaic compile helper crashes (HTTP 500, subprocess
+   exit 1, no diagnostics) on the per-row 16-byte async copies — while
+   trivial Pallas kernels compile and run fine through the same tunnel.
 
-Enable with THROTTLECRAB_PALLAS=1, set before the first kernel trace
-(each jit cache entry freezes the choice at trace time).  Off-TPU the
-kernels run in interpret mode — correct but orders of magnitude slower
-(the DMA ring is emulated); that mode exists for the correctness tests,
-not for measurement.
+The design stands as documentation: a RING-deep window of per-row async
+DMAs for gather and (unique-index) scatter, i64 GCRA arithmetic left to
+XLA (TPU vector lanes are 32-bit).  Enable with THROTTLECRAB_PALLAS=1,
+set before the first kernel trace (each jit cache entry freezes the
+choice at trace time).  Off-TPU the kernels run in interpret mode —
+correct but orders of magnitude slower (the DMA ring is emulated); that
+mode exists for the correctness tests, not for measurement.
 """
 
 from __future__ import annotations
@@ -70,25 +76,31 @@ def _dma_pipeline(chunk: int, copy) -> None:
     accounting lives here once so gather and scatter cannot diverge.
     """
 
+    # All loop scalars pinned to i32: the package enables jax x64
+    # globally, and i64 induction variables make Mosaic's scalar
+    # conversion helper recurse forever at lowering time (observed on
+    # v5e: RecursionError in _convert_helper).
+    i32 = jnp.int32
+
     def body(i, _):
         @pl.when(i >= RING)
         def _():
-            copy(i - RING).wait()
+            copy(i - i32(RING)).wait()
 
         copy(i).start()
-        return 0
+        return i32(0)
 
-    jax.lax.fori_loop(0, chunk, body, 0)
+    jax.lax.fori_loop(i32(0), i32(chunk), body, i32(0))
 
     def drain(i, _):
-        copy(jnp.maximum(chunk - RING, 0) + i).wait()
-        return 0
+        copy(i32(max(chunk - RING, 0)) + i).wait()
+        return i32(0)
 
-    jax.lax.fori_loop(0, min(RING, chunk), drain, 0)
+    jax.lax.fori_loop(i32(0), i32(min(RING, chunk)), drain, i32(0))
 
 
 def _gather_kernel(idx_ref, table_ref, out_ref, sem):
-    base = pl.program_id(0) * out_ref.shape[0]
+    base = pl.program_id(0) * jnp.int32(out_ref.shape[0])
 
     def copy(i):
         return pltpu.make_async_copy(
@@ -122,7 +134,7 @@ def row_gather(table, idx):
 
 
 def _scatter_kernel(idx_ref, rows_ref, table_ref, out_ref, sem):
-    base = pl.program_id(0) * rows_ref.shape[0]
+    base = pl.program_id(0) * jnp.int32(rows_ref.shape[0])
 
     def copy(i):
         return pltpu.make_async_copy(
