@@ -206,3 +206,74 @@ def test_sharded_many_param_conflict_falls_back():
         assert w.remaining.tolist() == g.remaining.tolist()
         assert w.reset_after_ns.tolist() == g.reset_after_ns.tolist()
         assert w.retry_after_ns.tolist() == g.retry_after_ns.tolist()
+
+
+def test_cur_mode_active_on_certified_traffic():
+    """dispatch_many picks the 8 B/request "cur" device output for
+    certified wire traffic and the results still match the exact path."""
+    lim = TpuRateLimiter(capacity=256)
+    handle = lim.dispatch_many(
+        [(["a", "b", "a"], 10, 100, 60, 1, T0)], wire=True
+    )
+    assert getattr(handle, "_cur", False), (
+        "certified wire window should take the cur output mode"
+    )
+    res = handle.fetch()[0]
+    assert isinstance(res, WireBatchResult)
+    assert res.allowed.all() and res.limit[0] == 10
+
+    lim2 = TpuRateLimiter(capacity=256)
+    ref = lim2.rate_limit_batch(["a", "b", "a"], 10, 100, 60, 1, T0)
+    np.testing.assert_array_equal(res.allowed, ref.allowed)
+    np.testing.assert_array_equal(res.remaining, ref.remaining)
+    np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_ns // NS)
+    np.testing.assert_array_equal(res.retry_after_s, ref.retry_after_ns // NS)
+
+
+def test_cur_mode_falls_back_on_big_tolerance():
+    """tol >= 2^61 (fits_cur_wire fails) must fall back to the 4-plane
+    compact output — same wire values, no overflow of the cur word."""
+    lim = TpuRateLimiter(capacity=256)
+    # burst * emission ~ 2^61: period huge relative to count.
+    big = (10, 1, 1 << 32, 1)  # burst, count, period(s), qty
+    handle = lim.dispatch_many(
+        [(["k"], big[0], big[1], big[2], big[3], T0)], wire=True
+    )
+    assert not getattr(handle, "_cur", True)
+    res = handle.fetch()[0]
+    assert bool(res.allowed[0])
+    ref = TpuRateLimiter(capacity=256).rate_limit_batch(
+        ["k"], big[0], big[1], big[2], big[3], T0, wire=True
+    )
+    np.testing.assert_array_equal(res.remaining, ref.remaining)
+    np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
+
+
+def test_native_wire_window_cur_matches_python_path():
+    """dispatch_wire_window (native prep + cur mode) returns the same
+    wire values as rate_limit_batch for identical certified traffic."""
+    from throttlecrab_tpu.native import toolchain_available
+
+    if not toolchain_available():
+        import pytest
+
+        pytest.skip("no C++ toolchain")
+    lim = TpuRateLimiter(capacity=256, keymap="native")
+    keys = [b"x", b"y", b"x", b"z"]
+    blob = b"".join(keys)
+    offsets = np.cumsum([0] + [len(k) for k in keys]).astype(np.int64)
+    params = np.array(
+        [[5, 100, 60, 1]] * 4, np.int64
+    )  # burst, count, period, qty
+    handle = lim.dispatch_wire_window([(blob, offsets, params)], T0)
+    assert handle is not None
+    res = handle.fetch()[0]
+
+    lim2 = TpuRateLimiter(capacity=256)
+    ref = lim2.rate_limit_batch(
+        ["x", "y", "x", "z"], 5, 100, 60, 1, T0, wire=True
+    )
+    np.testing.assert_array_equal(res.allowed, ref.allowed)
+    np.testing.assert_array_equal(res.remaining, ref.remaining)
+    np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
+    np.testing.assert_array_equal(res.retry_after_s, ref.retry_after_s)
