@@ -37,7 +37,13 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..core.errors import InternalError
-from ..tpu.kernel import EMPTY_EXPIRY, _gcra_body, pack_state, unpack_state
+from ..tpu.kernel import (
+    EMPTY_EXPIRY,
+    _gcra_body,
+    fits_cur_wire,
+    pack_state,
+    unpack_state,
+)
 from ..tpu.keymap import PyKeyMap
 from ..tpu.limiter import (
     BatchResult,
@@ -94,12 +100,17 @@ class ShardedBucketTable:
 
     # ------------------------------------------------------------------ #
 
-    def _step(self, with_degen: bool, compact: bool):
-        """Build (and cache) the jitted shard-mapped decision step."""
+    def _step(self, with_degen: bool, compact):
+        """Build (and cache) the jitted shard-mapped decision step.
+
+        `compact` may be "cur" (one i64/request off the mesh, see
+        kernel._finish) — the output rank and the allowed-counter read
+        change with it."""
         key = (with_degen, compact)
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
+        cur = compact == "cur"
 
         def local(state, slots, rank, is_last, em, tol, q, valid, now):
             st, out = _gcra_body(
@@ -117,7 +128,8 @@ class ShardedBucketTable:
                 with_degen=with_degen,
                 compact=compact,
             )
-            n_allowed = jnp.sum((out[0] != 0).astype(jnp.int64))
+            allowed_vec = (out & 1) if cur else (out[0] != 0)
+            n_allowed = jnp.sum(allowed_vec.astype(jnp.int64))
             n_valid = jnp.sum(valid[0].astype(jnp.int64))
             # The one collective on the hot path: global allowed/denied
             # totals over ICI (BASELINE config 5's psum-reduced counters).
@@ -126,6 +138,7 @@ class ShardedBucketTable:
             )
             return st[None], out[None], counters
 
+        out_spec = P(AXIS, None) if cur else P(AXIS, None, None)
         mapped = _shard_map(
             local,
             mesh=self.mesh,
@@ -140,7 +153,7 @@ class ShardedBucketTable:
                 P(AXIS, None),
                 P(),
             ),
-            out_specs=(P(AXIS, None, None), P(AXIS, None, None), P()),
+            out_specs=(P(AXIS, None, None), out_spec, P()),
         )
         fn = jax.jit(mapped, donate_argnums=(0,))
         self._step_cache[key] = fn
@@ -193,6 +206,7 @@ class ShardedBucketTable:
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
+        cur = compact == "cur"
 
         def local(state, slots, rank, is_last, em, tol, q, valid, now):
             def step(st, batch):
@@ -203,7 +217,8 @@ class ShardedBucketTable:
                     with_degen=with_degen,
                     compact=compact,
                 )
-                n_allowed = jnp.sum((out[0] != 0).astype(jnp.int64))
+                allowed_vec = (out & 1) if cur else (out[0] != 0)
+                n_allowed = jnp.sum(allowed_vec.astype(jnp.int64))
                 n_valid = jnp.sum(v.astype(jnp.int64))
                 return st, (out, jnp.stack([n_allowed, n_valid - n_allowed]))
 
@@ -218,6 +233,9 @@ class ShardedBucketTable:
             counters = lax.psum(counts.sum(axis=0), AXIS)
             return st[None], outs[None], counters
 
+        out_spec = (
+            P(AXIS, None, None) if cur else P(AXIS, None, None, None)
+        )
         mapped = _shard_map(
             local,
             mesh=self.mesh,
@@ -228,7 +246,7 @@ class ShardedBucketTable:
             ),
             out_specs=(
                 P(AXIS, None, None),
-                P(AXIS, None, None, None),
+                out_spec,
                 P(),
             ),
         )
@@ -333,19 +351,29 @@ class ShardedBucketTable:
 class _PendingShardedLaunch:
     """An in-flight mesh launch; .fetch() blocks on the stacked output,
     accumulates the psum'd global counters, and distributes per-batch
-    results."""
+    results.
 
-    def __init__(self, limiter, out_dev, counters, prepared, wire) -> None:
+    `now_list` is set iff the launch used the compact="cur" output
+    (i64[D, K, B], 8 B/request off the mesh instead of 16): fetch then
+    completes the exact i32 wire values per shard slice with
+    kernel.finish_cur, exactly like the single-device path."""
+
+    def __init__(
+        self, limiter, out_dev, counters, prepared, wire, now_list=None,
+    ) -> None:
         self._limiter = limiter
         self._out_dev = out_dev
         self._counters = counters
         self._prepared = prepared
         self._wire = wire
+        self._now_list = now_list
 
     def fetch(self) -> list:
         out = np.asarray(self._out_dev)
         c = np.asarray(self._counters)
         self._limiter._bump_counters(int(c[0]), int(c[1]))
+        if self._now_list is not None:
+            from ..tpu.kernel import finish_cur
         results = []
         for j, prep in enumerate(self._prepared):
             (n, per_shard, slots, rank, is_last, em, tol, q, vmask,
@@ -359,10 +387,20 @@ class _PendingShardedLaunch:
                 m = len(ix)
                 if m == 0:
                     continue
-                allowed[ix] = out[d, j, 0, :m] != 0
-                remaining[ix] = out[d, j, 1, :m]
-                reset_after[ix] = out[d, j, 2, :m]
-                retry_after[ix] = out[d, j, 3, :m]
+                if self._now_list is not None:
+                    al, rem, res, ret = finish_cur(
+                        out[d, j, :m], emission[ix], tolerance[ix],
+                        quantity[ix], self._now_list[j],
+                    )
+                    allowed[ix] = al != 0
+                    remaining[ix] = rem
+                    reset_after[ix] = res
+                    retry_after[ix] = ret
+                else:
+                    allowed[ix] = out[d, j, 0, :m] != 0
+                    remaining[ix] = out[d, j, 1, :m]
+                    reset_after[ix] = out[d, j, 2, :m]
+                    retry_after[ix] = out[d, j, 3, :m]
             results.append(
                 self._limiter._make_result(
                     valid, max_burst, status, allowed, remaining,
@@ -554,6 +592,13 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
         with_degen = not wire or has_degenerate(
             valid, emission, tolerance, quantity
         )
+        # 8 B/request "cur" output when the certified fast path and the
+        # fits_cur_wire bound hold (host-finished, same wire values).
+        use_cur = (
+            wire and not with_degen and fits_cur_wire(tolerance, now_ns)
+        )
+        if use_cur:
+            from ..tpu.kernel import finish_cur
 
         allowed = np.zeros(n, bool)
         remaining = np.zeros(n, np.int64)
@@ -574,7 +619,8 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
                     rk[d], il[d] = segment_info(slots[d], rmask[d])
             out_dev, counters = self.table.check_batch(
                 slots, rk, il, em, tol, q, rmask, now_ns,
-                with_degen=with_degen, compact=wire,
+                with_degen=with_degen,
+                compact="cur" if use_cur else wire,
             )
             out = np.asarray(out_dev)
             c = np.asarray(counters)
@@ -585,10 +631,20 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
                     continue
                 sel = rmask[d, :m]
                 dst = ix[sel]
-                allowed[dst] = out[d, 0, :m][sel] != 0
-                remaining[dst] = out[d, 1, :m][sel]
-                reset_after[dst] = out[d, 2, :m][sel]
-                retry_after[dst] = out[d, 3, :m][sel]
+                if use_cur:
+                    al, rem, res, ret = finish_cur(
+                        out[d, :m][sel], emission[dst], tolerance[dst],
+                        quantity[dst], now_ns,
+                    )
+                    allowed[dst] = al != 0
+                    remaining[dst] = rem
+                    reset_after[dst] = res
+                    retry_after[dst] = ret
+                else:
+                    allowed[dst] = out[d, 0, :m][sel] != 0
+                    remaining[dst] = out[d, 1, :m][sel]
+                    reset_after[dst] = out[d, 2, :m][sel]
+                    retry_after[dst] = out[d, 3, :m][sel]
 
         return self._make_result(
             valid, max_burst, status, allowed, remaining,
@@ -671,12 +727,24 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
             valid_s[:, j, :Bj] = vmask
             now_s[j] = batches[j][5]
 
+        # 8 B/request "cur" output off the mesh when the certified fast
+        # path and the fits_cur_wire bound hold (same rule as the
+        # single-device dispatch paths); host-finished in fetch().
+        from ..tpu.kernel import fits_cur_wire
+
+        use_cur = (
+            wire
+            and not any_degen
+            and fits_cur_wire(tol_s, int(now_s.max(initial=0)))
+        )
         out_dev, counters = self.table.check_many(
             slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s,
-            with_degen=not wire or any_degen, compact=wire,
+            with_degen=not wire or any_degen,
+            compact="cur" if use_cur else wire,
         )
         return _PendingShardedLaunch(
-            self, out_dev, counters, prepared, wire
+            self, out_dev, counters, prepared, wire,
+            now_list=[int(b[5]) for b in batches] if use_cur else None,
         )
 
     # ------------------------------------------------------------------ #
