@@ -233,9 +233,22 @@ def test_cur_mode_active_on_certified_traffic():
 def test_cur_mode_falls_back_on_big_tolerance():
     """tol >= 2^61 (fits_cur_wire fails) must fall back to the 4-plane
     compact output — same wire values, no overflow of the cur word."""
+    from throttlecrab_tpu.tpu.limiter import derive_params, has_degenerate
+
     lim = TpuRateLimiter(capacity=256)
-    # burst * emission ~ 2^61: period huge relative to count.
-    big = (10, 1, 1 << 32, 1)  # burst, count, period(s), qty
+    # Non-degenerate but tol = em*(burst-1) = 3e18 >= 2^61: this batch
+    # is exactly the case the fits_cur_wire guard exists for — it must
+    # NOT be rejected by the degeneracy certificate (or this test would
+    # pass without exercising the guard at all).
+    big = (3_000_000_000, 1, 1, 1)  # burst, count, period(s), qty
+    em, tol, invalid = derive_params(
+        np.array([big[0]], np.int64), np.array([big[1]], np.int64),
+        np.array([big[2]], np.int64),
+    )
+    assert not invalid[0] and tol[0] >= (1 << 61)
+    assert not has_degenerate(
+        np.array([True]), em, tol, np.array([big[3]], np.int64)
+    )
     handle = lim.dispatch_many(
         [(["k"], big[0], big[1], big[2], big[3], T0)], wire=True
     )

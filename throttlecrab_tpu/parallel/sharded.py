@@ -174,7 +174,9 @@ class ShardedBucketTable:
     ):
         """Decide stacked ``[D, B]`` per-shard batches in one launch.
 
-        Returns (out[D, 4, B] device array, (allowed, denied) global counts).
+        Returns (out device array, (allowed, denied) global counts);
+        out is [D, 4, B] planes, or i64[D, B] `cur*2+allowed` words when
+        compact="cur" (host-finish with kernel.finish_cur).
         """
         assert slots.shape[1] <= self.SCRATCH
         step = self._step(with_degen, compact)
@@ -270,7 +272,9 @@ class ShardedBucketTable:
         """K stacked sub-batches per shard (``[D, K, B]`` inputs, i64[K]
         timestamps) in ONE launch.
 
-        Returns (out[D, K, 4, B] device array, (allowed, denied) totals).
+        Returns (out device array, (allowed, denied) totals); out is
+        [D, K, 4, B] planes, or i64[D, K, B] `cur*2+allowed` words when
+        compact="cur" (host-finish with kernel.finish_cur).
         """
         assert slots.shape[2] <= self.SCRATCH
         step = self._scan_step(with_degen, compact)
@@ -730,8 +734,6 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
         # 8 B/request "cur" output off the mesh when the certified fast
         # path and the fits_cur_wire bound hold (same rule as the
         # single-device dispatch paths); host-finished in fetch().
-        from ..tpu.kernel import fits_cur_wire
-
         use_cur = (
             wire
             and not any_degen
