@@ -297,7 +297,7 @@ def run_byid(
     km.intern(keys)
     slots = km.resolve_all()
     assert (slots >= 0).all(), "table full during setup"
-    id_rows = table.upload_id_rows(slots, em_all, tol_all)
+    id_rows = table.upload_id_rows(slots, em_all, tol_all, keymap=km)
 
     def dispatch(ids, now_ns):
         words, n_bad = km.assemble_ids(ids, BATCH)

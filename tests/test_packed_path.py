@@ -322,3 +322,41 @@ class TestByIdPath:
         meta = words >> 32
         valid = (meta & (1 << 15)) != 0
         np.testing.assert_array_equal(valid, [True, True, False, False])
+
+    def test_stale_id_rows_guarded(self, native_km):
+        """A sweep or growth remaps slots; the ResidentIdRows guard must
+        refuse to launch against the stale device rows."""
+        from throttlecrab_tpu.tpu.table import (
+            BucketTable,
+            StaleIdRowsError,
+        )
+
+        km = native_km
+        km.intern([b"g:%d" % i for i in range(8)])
+        slots = km.resolve_all()
+        em = np.full(8, 10**9, np.int64)
+        tol = em * 4
+        table = BucketTable(64)
+        rows = table.upload_id_rows(slots, em, tol, keymap=km)
+        words, bad = km.assemble_ids(np.arange(8, dtype=np.int32), 8)
+        assert not bad
+        now = np.array([1_753_000_000_000_000_000], np.int64)
+        table.check_many_byid(
+            rows, words.reshape(1, 8), now, 1,
+            with_degen=False, compact="cur",
+        )  # fresh rows serve fine
+
+        km.free_slots(slots[:2])  # sweep analog: slots recycled
+        with pytest.raises(StaleIdRowsError):
+            table.check_many_byid(
+                rows, words.reshape(1, 8), now, 1,
+                with_degen=False, compact="cur",
+            )
+        # Re-upload refreshes the guard.
+        rows2 = table.upload_id_rows(km.resolve_all(), em, tol, keymap=km)
+        words2, bad2 = km.assemble_ids(np.arange(8, dtype=np.int32), 8)
+        assert not bad2
+        table.check_many_byid(
+            rows2, words2.reshape(1, 8), now, 1,
+            with_degen=False, compact="cur",
+        )

@@ -231,6 +231,10 @@ class NativeKeyMap:
             raise RuntimeError(f"native keymap unavailable: {_build_error}")
         self._lib = lib
         self._h = lib.tk_create(capacity)
+        # Bumped by every slot-remapping operation (sweep frees, growth);
+        # device-resident id rows (table.ResidentIdRows) pin the value
+        # they were built at and refuse to serve once it moves.
+        self.mutations = 0
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -498,14 +502,18 @@ class NativeKeyMap:
 
     def free_slots(self, slot_indices: np.ndarray) -> int:
         arr = np.ascontiguousarray(slot_indices, np.int32)
-        return int(
+        n = int(
             self._lib.tk_free_slots(
                 self._h, arr.ctypes.data_as(ctypes.c_void_p), len(arr)
             )
         )
+        if n:
+            self.mutations += 1
+        return n
 
     def grow(self, new_capacity: int) -> None:
         self._lib.tk_grow(self._h, new_capacity)
+        self.mutations += 1
 
     def items(self):
         """(key_bytes, slot) pairs for every live entry (snapshot export)."""

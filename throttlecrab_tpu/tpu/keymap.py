@@ -27,6 +27,10 @@ class PyKeyMap:
         # Stack of free slots; pop from the end (low indices first).
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         self._rev: List[Optional[object]] = [None] * capacity
+        # Bumped by every slot-remapping operation (sweep frees, growth);
+        # device-resident id rows (table.ResidentIdRows) pin the value
+        # they were built at and refuse to serve once it moves.
+        self.mutations = 0
 
     def __len__(self) -> int:
         return len(self._map)
@@ -80,6 +84,8 @@ class PyKeyMap:
             self._rev[slot] = None
             self._free.append(slot)
             n += 1
+        if n:
+            self.mutations += 1
         return n
 
     def grow(self, new_capacity: int) -> None:
@@ -88,6 +94,7 @@ class PyKeyMap:
         self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
         self._rev.extend([None] * (new_capacity - self.capacity))
         self.capacity = new_capacity
+        self.mutations += 1
 
     def items(self):
         """(key, slot) pairs for every live entry (snapshot export)."""

@@ -33,6 +33,36 @@ from .kernel import (
 )
 
 
+class StaleIdRowsError(RuntimeError):
+    """Device-resident by-id parameter rows refer to slots the keymap has
+    since remapped (sweep freed them or the table grew); re-run
+    upload_id_rows before the next by-id launch."""
+
+
+class ResidentIdRows:
+    """Device-resident by-id parameter rows plus a staleness guard.
+
+    Pins the keymap's `mutations` counter at build time; any later sweep
+    or growth bumps it, and the next by-id launch raises
+    StaleIdRowsError instead of silently deciding against stale slots.
+    """
+
+    def __init__(self, rows: jax.Array, keymap) -> None:
+        self.rows = rows
+        self._keymap = keymap
+        self._stamp = getattr(keymap, "mutations", 0)
+
+    def rows_checked(self) -> jax.Array:
+        current = getattr(self._keymap, "mutations", 0)
+        if current != self._stamp:
+            raise StaleIdRowsError(
+                "by-id parameter rows are stale: the keymap remapped "
+                f"slots since upload (mutations {self._stamp} -> "
+                f"{current}); re-run upload_id_rows"
+            )
+        return self.rows
+
+
 class BucketTable:
     """Per-slot GCRA state on a single device."""
 
@@ -165,21 +195,31 @@ class BucketTable:
         return out
 
     def upload_id_rows(
-        self, slots, emission, tolerance
-    ) -> jax.Array:
+        self, slots, emission, tolerance, keymap=None
+    ):
         """Build and upload the by-id parameter rows for check_many_byid:
         i32[n_ids, IDROW_WIDTH] = [slot, em_lo/hi, tol_lo/hi, pad].  One
         untimed setup transfer; the rows then stay device-resident so a
         request costs 8 bytes on the wire instead of the 36-byte packed
         row (the tunnel's ~10-50 MB/s serialized link is the launch
-        throughput ceiling — docs/tpu-launch-profile.md).  Re-upload
-        after a sweep or growth remaps slots."""
-        rows = pack_id_rows(slots, emission, tolerance)
-        return jax.device_put(rows, self.device)
+        throughput ceiling — docs/tpu-launch-profile.md).
+
+        A sweep or growth remaps slots and silently invalidates the
+        uploaded rows; pass the `keymap` the slots came from to get a
+        ResidentIdRows guard that raises StaleIdRowsError instead of
+        deciding against stale slots (re-upload to refresh).  Without
+        `keymap` the raw device array is returned and freshness is the
+        caller's contract."""
+        rows = jax.device_put(
+            pack_id_rows(slots, emission, tolerance), self.device
+        )
+        if keymap is None:
+            return rows
+        return ResidentIdRows(rows, keymap)
 
     def check_many_byid(
         self,
-        id_rows: jax.Array,
+        id_rows,
         words,
         now_ns,
         quantity: int = 1,
@@ -187,9 +227,12 @@ class BucketTable:
         compact=False,
     ) -> jax.Array:
         """K stacked micro-batches of 8-byte request words (i64[K, B],
-        tk_assemble_ids layout) against resident `id_rows`.  `quantity`
-        is launch-uniform.  Returns the device output per `compact`
-        (see check_many_packed) without fetching."""
+        tk_assemble_ids layout) against resident `id_rows` (a raw device
+        array or a ResidentIdRows guard, which is freshness-checked).
+        `quantity` is launch-uniform.  Returns the device output per
+        `compact` (see check_many_packed) without fetching."""
+        if isinstance(id_rows, ResidentIdRows):
+            id_rows = id_rows.rows_checked()
         assert words.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
         self.state, out = gcra_scan_byid(
             self.state,
