@@ -142,6 +142,14 @@ def main() -> int:
     ap.add_argument("--no-resident", action="store_true",
                     help="skip the device-resident kernel-ceiling "
                          "measurement")
+    ap.add_argument("--segment", choices=("auto", "device", "host"),
+                    default="auto",
+                    help="byid path: derive duplicate-segment structure "
+                         "on-device from raw 4 B ids, or ship host-built "
+                         "8 B words (tk_assemble_ids).  auto = device on "
+                         "TPU (sort ~0.09 ms/batch, saves 4 B/request on "
+                         "the serialized tunnel), host elsewhere (the "
+                         "1-vCPU XLA sort costs more than it saves)")
     ap.add_argument("--pallas", action="store_true",
                     help="route table row gather/scatter through the "
                          "Pallas DMA kernels (tpu/pallas_ops.py)")
@@ -236,10 +244,14 @@ def main() -> int:
     }
 
     if path == "byid":
+        segment = args.segment
+        if segment == "auto":
+            segment = "device" if device.platform == "tpu" else "host"
+        extra["segment"] = segment
         rate = run_byid(
             limiter, keys, em_all, tol_all, rng, n_keys, depth,
             args.pipe, warm_launches, timed_launches, args.profile,
-            not args.no_resident, extra,
+            not args.no_resident, segment == "device", extra,
         )
     elif path == "packed":
         rate = run_packed(
@@ -271,20 +283,26 @@ def main() -> int:
 
 def run_byid(
     limiter, keys, em_all, tol_all, rng, n_keys, depth, pipe,
-    warm_launches, timed_launches, profile_dir, resident, extra,
+    warm_launches, timed_launches, profile_dir, resident, dev_segment,
+    extra,
 ):
-    """The minimum-wire-bytes path: 8 B/request launch words + resident
-    parameter rows + 8 B/request compact="cur" outputs.
+    """The minimum-wire-bytes path: resident per-key parameter rows +
+    8 B/request compact="cur" outputs, fed by either
+
+      - raw 4 B/request key ids with the duplicate-segment structure
+        derived ON-DEVICE by a stable sort (`--segment device`, the
+        default: kernel.gcra_scan_ids — nothing but the id stream
+        crosses the wire, and no C++ assembly runs at dispatch), or
+      - 8 B/request i64 words built by C++ tk_assemble_ids
+        (`--segment host`: kernel.gcra_scan_byid).
 
     The tunnel to the TPU moves ~10-50 MB/s TOTAL, serialized across
     h2d, compute and d2h (scripts/probe_duplex.py), so request bytes set
-    the throughput ceiling.  Per launch: one C++ call
-    (tk_assemble_ids) turns raw key ids into i64 words (id + segment
-    structure), the device gathers (slot, emission, tolerance) from
-    id rows uploaded once at setup, and the fetch returns one i64 per
-    request, finished to exact i32 wire values by C++ tk_finish_ids.
-    Fetches run on a thread pool — the relay serves concurrent reads
-    faster than serial blocking ones.
+    the throughput ceiling; the on-device sort costs ~23 ms per
+    256-deep launch and saves ~4.2 MB of upload.  The fetch returns one
+    i64 per request, finished to exact i32 wire values by C++
+    tk_finish_raw / tk_finish_ids on a thread pool — the relay serves
+    concurrent reads faster than serial blocking ones.
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -299,24 +317,33 @@ def run_byid(
     assert (slots >= 0).all(), "table full during setup"
     id_rows = table.upload_id_rows(slots, em_all, tol_all, keymap=km)
 
+    common = dict(
+        quantity=1,
+        with_degen=False,  # certified: qty=1, burst>1, emission>0,
+        compact="cur",     # tol>0, now/tol < 2**61 (fits_cur_wire)
+    )
+
     def dispatch(ids, now_ns):
+        now_arr = np.full(depth, now_ns, np.int64)
+        if dev_segment:
+            out = table.check_many_ids(
+                id_rows, ids.reshape(depth, BATCH), now_arr, **common
+            )
+            return ids, out, now_ns
         words, n_bad = km.assemble_ids(ids, BATCH)
         assert not n_bad
         out = table.check_many_byid(
-            id_rows,
-            words.reshape(depth, BATCH),
-            np.full(depth, now_ns, np.int64),
-            quantity=1,
-            with_degen=False,  # certified: qty=1, burst>1, emission>0,
-            compact="cur",     # tol>0, now/tol < 2**61 (fits_cur_wire)
+            id_rows, words.reshape(depth, BATCH), now_arr, **common
         )
         return words, out, now_ns
 
-    def complete(words, out, now_ns):
+    def complete(carrier, out, now_ns):
         """Fetch the 8 B/request device words and finish the exact i32
         wire values (allowed, remaining, reset_s, retry_s) in C++."""
         cur2 = np.asarray(out)
-        return km.finish_ids(words, em_all, tol_all, 1, cur2, now_ns)
+        if dev_segment:
+            return km.finish_raw(carrier, em_all, tol_all, 1, cur2, now_ns)
+        return km.finish_ids(carrier, em_all, tol_all, 1, cur2, now_ns)
 
     # ---- populate: every key once, pipelined, no per-chunk blocking ------
     t_pop = time.perf_counter()
@@ -364,18 +391,20 @@ def run_byid(
         R = 8
         staged = []
         for _ in range(R):
-            w, n_bad = km.assemble_ids(
-                zipf_indices(rng, n_keys, per_launch).astype(np.int32),
-                BATCH,
-            )
-            assert not n_bad
-            wd = jax.device_put(w.reshape(depth, BATCH))
+            ids_r = zipf_indices(rng, n_keys, per_launch).astype(np.int32)
+            if dev_segment:
+                wd = jax.device_put(ids_r.reshape(depth, BATCH))
+            else:
+                w, n_bad = km.assemble_ids(ids_r, BATCH)
+                assert not n_bad
+                wd = jax.device_put(w.reshape(depth, BATCH))
             np.asarray(_sum(wd))  # settle the upload (untimed)
             staged.append(wd)
+        check = table.check_many_ids if dev_segment else table.check_many_byid
         t0 = time.perf_counter()
         checks = []
         for r, wd in enumerate(staged):
-            out = table.check_many_byid(
+            out = check(
                 id_rows, wd,
                 np.full(depth, T0 + r * 50_000_000, np.int64),
                 quantity=1, with_degen=False, compact="cur",

@@ -450,6 +450,21 @@ static inline void finish_one(int64_t em, int64_t tol, int64_t qty,
     o[3] = static_cast<int32_t>(retry_s < I32MAX ? retry_s : I32MAX);
 }
 
+// tk_finish for the raw-ids path (gcra_scan_ids): the request stream is
+// bare i32 ids (negative = padding), parameters from the host tables.
+void tk_finish_raw(const int32_t* ids, const int64_t* em_by_id,
+                   const int64_t* tol_by_id, int64_t quantity,
+                   const int64_t* cur2, int64_t n, int64_t now,
+                   int32_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        const int32_t id = ids[i];
+        const bool valid = id >= 0;
+        const int64_t em = valid ? em_by_id[id] : 0;
+        const int64_t tol = valid ? tol_by_id[id] : 0;
+        finish_one(em, tol, quantity, cur2[i], now, out + i * 4);
+    }
+}
+
 // tk_finish for the by-id path: emission/tolerance come from the host
 // parameter tables indexed by the id in each request word; quantity is
 // the launch-uniform scalar.

@@ -360,3 +360,53 @@ class TestByIdPath:
             rows2, words2.reshape(1, 8), now, 1,
             with_degen=False, compact="cur",
         )
+
+    def test_raw_ids_matches_host_words(self, native_km):
+        """gcra_scan_ids (4 B raw ids, on-device segmenting) must match
+        gcra_scan_byid (host-built words) on duplicate-heavy traffic
+        with padding holes: same cur words, same wire values, same
+        table state."""
+        from throttlecrab_tpu.tpu.table import BucketTable
+
+        km = native_km
+        n, B, K = 40, 32, 4
+        km.intern([b"k:%d" % i for i in range(n)])
+        em = (np.arange(n, dtype=np.int64) % 7 + 1) * 250_000_000
+        tol = em * (np.arange(n, dtype=np.int64) % 5 + 2)
+        rng = np.random.RandomState(11)
+        ids = rng.randint(0, n, K * B).astype(np.int32)
+        ids[[3, 17, 40, 100]] = -1  # padding holes mid-batch
+        now = np.full(K, 1_753_000_000_000_000_000, np.int64)
+
+        words, bad = km.assemble_ids(ids, B)
+        assert not bad
+        slots = km.resolve_all()
+
+        t1 = BucketTable(128)
+        r1 = t1.upload_id_rows(slots, em, tol)
+        out_w = np.asarray(
+            t1.check_many_byid(
+                r1, words.reshape(K, B), now, 1,
+                with_degen=False, compact="cur",
+            )
+        ).reshape(-1)
+        wire_w = km.finish_ids(words, em, tol, 1, out_w, int(now[0]))
+
+        t2 = BucketTable(128)
+        r2 = t2.upload_id_rows(slots, em, tol)
+        out_r = np.asarray(
+            t2.check_many_ids(
+                r2, ids.reshape(K, B), now, 1,
+                with_degen=False, compact="cur",
+            )
+        ).reshape(-1)
+        wire_r = km.finish_raw(ids, em, tol, 1, out_r, int(now[0]))
+
+        valid = ids >= 0
+        np.testing.assert_array_equal(out_w[valid], out_r[valid])
+        np.testing.assert_array_equal(wire_w[valid], wire_r[valid])
+        # Allowed bit masked off on padding lanes in both paths.
+        assert not (out_r[~valid] & 1).any()
+        np.testing.assert_array_equal(
+            np.asarray(t1.state)[:64], np.asarray(t2.state)[:64]
+        )

@@ -25,6 +25,7 @@ from .kernel import (
     gcra_batch,
     gcra_scan,
     gcra_scan_byid,
+    gcra_scan_ids,
     gcra_scan_packed,
     pack_id_rows,
     pack_state,
@@ -42,9 +43,10 @@ class StaleIdRowsError(RuntimeError):
 class ResidentIdRows:
     """Device-resident by-id parameter rows plus a staleness guard.
 
-    Pins the keymap's `mutations` counter at build time; any later sweep
-    or growth bumps it, and the next by-id launch raises
-    StaleIdRowsError instead of silently deciding against stale slots.
+    Pins the keymap's `mutations` counter at build time; any later
+    sweep, growth, or intern of new ids bumps it, and the next by-id
+    launch raises StaleIdRowsError instead of silently deciding against
+    stale or uncovered slots.
     """
 
     def __init__(self, rows: jax.Array, keymap) -> None:
@@ -240,6 +242,36 @@ class BucketTable:
             words
             if isinstance(words, jax.Array)
             else jnp.asarray(words, jnp.int64),
+            jnp.asarray(now_ns, jnp.int64),
+            quantity,
+            with_degen=with_degen,
+            compact=compact,
+        )
+        return out
+
+    def check_many_ids(
+        self,
+        id_rows,
+        ids,
+        now_ns,
+        quantity: int = 1,
+        with_degen: bool = True,
+        compact=False,
+    ) -> jax.Array:
+        """K stacked micro-batches of RAW key ids (i32[K, B], negative =
+        padding) against resident `id_rows`: 4 bytes per request on the
+        wire, duplicate-segment structure derived on-device
+        (kernel.gcra_scan_ids).  Accepts a ResidentIdRows guard like
+        check_many_byid.  Returns the device output per `compact`."""
+        if isinstance(id_rows, ResidentIdRows):
+            id_rows = id_rows.rows_checked()
+        assert ids.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
+        self.state, out = gcra_scan_ids(
+            self.state,
+            id_rows,
+            ids
+            if isinstance(ids, jax.Array)
+            else jnp.asarray(ids, jnp.int32),
             jnp.asarray(now_ns, jnp.int64),
             quantity,
             with_degen=with_degen,
