@@ -410,3 +410,34 @@ class TestByIdPath:
         np.testing.assert_array_equal(
             np.asarray(t1.state)[:64], np.asarray(t2.state)[:64]
         )
+
+    def test_raw_ids_hot_key_burst_semantics(self, native_km):
+        """One key duplicated across a whole raw-ids batch must admit
+        exactly `burst` requests in rank order — the on-device segment
+        derivation reproducing the reference's sequential semantics."""
+        from throttlecrab_tpu.tpu.table import BucketTable
+
+        km = native_km
+        km.intern([b"hot", b"cold"])
+        slots = km.resolve_all()
+        burst = 10
+        em = np.full(2, 6_000_000_000, np.int64)  # period/count = 6s
+        tol = em * (burst - 1)
+        table = BucketTable(64)
+        rows = table.upload_id_rows(slots, em, tol)
+        ids = np.zeros(64, np.int32)  # 63x hot + 1 cold in the middle
+        ids[31] = 1
+        now = np.array([1_753_000_000_000_000_000], np.int64)
+        out = np.asarray(
+            table.check_many_ids(
+                rows, ids.reshape(1, 64), now, 1,
+                with_degen=False, compact="cur",
+            )
+        ).reshape(-1)
+        allowed = (out & 1) != 0
+        hot = ids == 0
+        assert int(allowed[hot].sum()) == burst
+        # Prefix property: the first `burst` hot occurrences are the
+        # allowed ones (arrival order preserved through the sort).
+        assert allowed[hot][:burst].all() and not allowed[hot][burst:].any()
+        assert allowed[31]  # the cold key is its own segment
