@@ -441,3 +441,37 @@ class TestByIdPath:
         # allowed ones (arrival order preserved through the sort).
         assert allowed[hot][:burst].all() and not allowed[hot][burst:].any()
         assert allowed[31]  # the cold key is its own segment
+
+    def test_finish_raw_rejects_out_of_table_ids(self, native_km):
+        km = native_km
+        km.intern([b"a", b"b"])
+        em = np.array([10**9, 10**9], np.int64)
+        tol = em * 3
+        cur2 = np.zeros(3, np.int64)
+        with pytest.raises(ValueError):
+            km.finish_raw(
+                np.array([0, 1, 2], np.int32), em, tol, 1, cur2, 0
+            )
+
+    def test_intern_after_upload_invalidates_rows(self, native_km):
+        """Ids interned after upload are not covered by the resident
+        rows; the guard must force a re-upload rather than let the
+        kernel clip the new id onto another key's row."""
+        from throttlecrab_tpu.tpu.table import (
+            BucketTable,
+            StaleIdRowsError,
+        )
+
+        km = native_km
+        km.intern([b"old"])
+        em = np.array([10**9], np.int64)
+        tol = em * 3
+        table = BucketTable(64)
+        rows = table.upload_id_rows(km.resolve_all(), em, tol, keymap=km)
+        km.intern([b"new"])
+        now = np.array([1_753_000_000_000_000_000], np.int64)
+        with pytest.raises(StaleIdRowsError):
+            table.check_many_ids(
+                rows, np.array([[1]], np.int32), now, 1,
+                with_degen=False, compact="cur",
+            )
