@@ -282,6 +282,121 @@ def main() -> int:
     return 0
 
 
+def _populate(dispatch, rng, n_keys, per_launch, pipe, limiter, extra):
+    """Touch every key once through `dispatch`, pipelined, fetching only
+    to bound the in-flight window (outputs are discarded)."""
+    t_pop = time.perf_counter()
+    pop_order = rng.permutation(n_keys).astype(np.int32)
+    pending = deque()
+    for start in range(0, n_keys, per_launch):
+        chunk = pop_order[start : start + per_launch]
+        ids = np.full(per_launch, -1, np.int32)
+        ids[: len(chunk)] = chunk
+        pending.append(dispatch(ids, T0)[1])
+        if len(pending) > pipe:
+            np.asarray(pending.popleft())
+    while pending:
+        np.asarray(pending.popleft())
+    extra["populate_s"] = round(time.perf_counter() - t_pop, 2)
+    print(
+        f"populated {len(limiter)} keys in {extra['populate_s']}s",
+        file=sys.stderr,
+    )
+
+
+def _timed_trials(
+    dispatch, complete, rng, n_keys, per_launch, pipe,
+    warm_launches, timed_launches, profile_dir, extra,
+):
+    """The shared timed phase: Zipf-skewed launches, PIPE in flight,
+    fetch+finish on a 3-worker pool, TWO independent trials reporting
+    the better one (the relay's delivered bandwidth swings ~4x between
+    minutes — docs/benchmark-results.md host-condition caveat — and a
+    throughput capability metric should not inherit a transient
+    trough; both trial rates land in the JSON).  --profile captures
+    exactly trial 0's timed launches."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import contextlib
+
+    n_launches = warm_launches + timed_launches
+    draws = zipf_indices(rng, n_keys, n_launches * per_launch).astype(
+        np.int32
+    )
+    chunks = [
+        draws[i * per_launch : (i + 1) * per_launch]
+        for i in range(n_launches)
+    ]
+
+    pool = ThreadPoolExecutor(max_workers=3)
+    trial_rates = []
+    best = None
+    for trial in range(2):
+        pending = deque()
+        for li in range(warm_launches):
+            pending.append(pool.submit(complete, *dispatch(
+                chunks[li], T0 + (trial * n_launches + li) * 50_000_000
+            )))
+        while pending:
+            pending.popleft().result()
+
+        if profile_dir and trial == 0:
+            from throttlecrab_tpu.tpu.profiling import trace
+
+            profiler = trace(profile_dir)
+            extra["trace_dir"] = profile_dir
+            extra["trace_trial"] = 0
+        else:
+            profiler = contextlib.nullcontext()
+
+        with profiler:
+            t_dispatch = {}
+            latencies = []
+            t_start = time.perf_counter()
+            for li in range(warm_launches, n_launches):
+                t_dispatch[li] = time.perf_counter()
+                now_ns = T0 + (trial * n_launches + li) * 50_000_000
+                pending.append(
+                    (li, pool.submit(complete, *dispatch(
+                        chunks[li], now_ns
+                    )))
+                )
+                if len(pending) > pipe:
+                    j, fut = pending.popleft()
+                    fut.result()
+                    latencies.append(time.perf_counter() - t_dispatch[j])
+            while pending:
+                j, fut = pending.popleft()
+                fut.result()
+                latencies.append(time.perf_counter() - t_dispatch[j])
+            elapsed = time.perf_counter() - t_start
+            trial_rates.append(
+                round(timed_launches * per_launch / elapsed)
+            )
+            if best is None or elapsed < best[0]:
+                best = (elapsed, latencies)
+    pool.shutdown()
+
+    elapsed, latencies = best
+    decided = timed_launches * per_launch
+    lat = np.sort(np.asarray(latencies))
+    extra.update(
+        {
+            "elapsed_s": round(elapsed, 3),
+            "decisions": decided,
+            "trial_rates": trial_rates,
+            "fetch_latency_p50_ms": round(
+                float(lat[int(0.50 * len(lat))]) * 1e3, 3
+            ),
+            "fetch_latency_p99_ms": round(
+                float(lat[min(int(0.99 * len(lat)), len(lat) - 1)]) * 1e3, 3
+            ),
+            "launch_wall_ms": round(elapsed / timed_launches * 1e3, 3),
+        }
+    )
+    return decided / elapsed
+
+
 def run_byid(
     limiter, keys, em_all, tol_all, rng, n_keys, depth, pipe,
     warm_launches, timed_launches, profile_dir, resident, dev_segment,
@@ -346,24 +461,7 @@ def run_byid(
             return km.finish_raw(carrier, em_all, tol_all, 1, cur2, now_ns)
         return km.finish_ids(carrier, em_all, tol_all, 1, cur2, now_ns)
 
-    # ---- populate: every key once, pipelined, no per-chunk blocking ------
-    t_pop = time.perf_counter()
-    pop_order = rng.permutation(n_keys).astype(np.int32)
-    pending = deque()
-    for start in range(0, n_keys, per_launch):
-        chunk = pop_order[start : start + per_launch]
-        ids = np.full(per_launch, -1, np.int32)
-        ids[: len(chunk)] = chunk
-        pending.append(dispatch(ids, T0)[1])
-        if len(pending) > pipe:
-            np.asarray(pending.popleft())
-    while pending:
-        np.asarray(pending.popleft())
-    extra["populate_s"] = round(time.perf_counter() - t_pop, 2)
-    print(
-        f"populated {len(limiter)} keys in {extra['populate_s']}s",
-        file=sys.stderr,
-    )
+    _populate(dispatch, rng, n_keys, per_launch, pipe, limiter, extra)
 
     # ---- host-assembly-only throughput -----------------------------------
     probe_ids = zipf_indices(rng, n_keys, per_launch).astype(np.int32)
@@ -452,93 +550,10 @@ def run_byid(
                 file=sys.stderr,
             )
 
-    # ---- workload: Zipf-skewed launches, PIPE in flight ------------------
-    # Two independent trials, report the better: the tunnel's delivered
-    # bandwidth swings ~4x between minutes on the shared relay (measured
-    # against identical code — docs/benchmark-results.md host-condition
-    # caveat), and a throughput capability metric should not inherit a
-    # transient trough.  Both trial rates land in the JSON.
-    n_launches = warm_launches + timed_launches
-    draws = zipf_indices(rng, n_keys, n_launches * per_launch).astype(
-        np.int32
+    return _timed_trials(
+        dispatch, complete, rng, n_keys, per_launch, pipe,
+        warm_launches, timed_launches, profile_dir, extra,
     )
-    chunks = [
-        draws[i * per_launch : (i + 1) * per_launch]
-        for i in range(n_launches)
-    ]
-
-    import contextlib
-
-    pool = ThreadPoolExecutor(max_workers=3)
-    trial_rates = []
-    best = None
-    for trial in range(2):
-        pending = deque()
-        for li in range(warm_launches):
-            pending.append(pool.submit(complete, *dispatch(
-                chunks[li], T0 + (trial * n_launches + li) * 50_000_000
-            )))
-        while pending:
-            pending.popleft().result()
-
-        # Trace only the FIRST trial's timed region (after its warm-up):
-        # a trace of everything would be mostly warm-up plus a trial the
-        # report may discard.
-        if profile_dir and trial == 0:
-            from throttlecrab_tpu.tpu.profiling import trace
-
-            profiler = trace(profile_dir)
-            extra["trace_dir"] = profile_dir
-            extra["trace_trial"] = 0
-        else:
-            profiler = contextlib.nullcontext()
-
-        with profiler:
-            t_dispatch = {}
-            latencies = []
-            t_start = time.perf_counter()
-            for li in range(warm_launches, n_launches):
-                t_dispatch[li] = time.perf_counter()
-                now_ns = T0 + (trial * n_launches + li) * 50_000_000
-                pending.append(
-                    (li, pool.submit(complete, *dispatch(
-                        chunks[li], now_ns
-                    )))
-                )
-                if len(pending) > pipe:
-                    j, fut = pending.popleft()
-                    fut.result()
-                    latencies.append(time.perf_counter() - t_dispatch[j])
-            while pending:
-                j, fut = pending.popleft()
-                fut.result()
-                latencies.append(time.perf_counter() - t_dispatch[j])
-            elapsed = time.perf_counter() - t_start
-            trial_rates.append(
-                round(timed_launches * per_launch / elapsed)
-            )
-            if best is None or elapsed < best[0]:
-                best = (elapsed, latencies)
-    pool.shutdown()
-
-    elapsed, latencies = best
-    decided = timed_launches * per_launch
-    lat = np.sort(np.asarray(latencies))
-    extra.update(
-        {
-            "elapsed_s": round(elapsed, 3),
-            "decisions": decided,
-            "trial_rates": trial_rates,
-            "fetch_latency_p50_ms": round(
-                float(lat[int(0.50 * len(lat))]) * 1e3, 3
-            ),
-            "fetch_latency_p99_ms": round(
-                float(lat[min(int(0.99 * len(lat)), len(lat) - 1)]) * 1e3, 3
-            ),
-            "launch_wall_ms": round(elapsed / timed_launches * 1e3, 3),
-        }
-    )
-    return decided / elapsed
 
 
 def run_packed(
@@ -555,8 +570,6 @@ def run_packed(
     that HURTS on this relay (387 ms vs 264 ms per launch at depth 64 —
     the early copy request serializes against the compute stream), so
     both paths rely on the 3-thread fetch pool alone."""
-    from concurrent.futures import ThreadPoolExecutor
-
     from throttlecrab_tpu.tpu.kernel import PACK_WIDTH as W
 
     km = limiter.keymap
@@ -582,24 +595,7 @@ def run_packed(
         cur2 = np.asarray(out)
         return km.finish(packed, cur2, now_ns)
 
-    # ---- populate: every key once, pipelined, no per-chunk blocking ------
-    t_pop = time.perf_counter()
-    pop_order = rng.permutation(n_keys).astype(np.int32)
-    pending = deque()
-    for start in range(0, n_keys, per_launch):
-        chunk = pop_order[start : start + per_launch]
-        ids = np.full(per_launch, -1, np.int32)
-        ids[: len(chunk)] = chunk
-        pending.append(dispatch(ids, T0)[1])
-        if len(pending) > pipe:
-            np.asarray(pending.popleft())
-    while pending:
-        np.asarray(pending.popleft())
-    extra["populate_s"] = round(time.perf_counter() - t_pop, 2)
-    print(
-        f"populated {len(limiter)} keys in {extra['populate_s']}s",
-        file=sys.stderr,
-    )
+    _populate(dispatch, rng, n_keys, per_launch, pipe, limiter, extra)
 
     # ---- host-assembly-only throughput (VERDICT r3 #2 deliverable) -------
     probe_ids = zipf_indices(rng, n_keys, per_launch).astype(np.int32)
@@ -615,78 +611,10 @@ def run_packed(
         file=sys.stderr,
     )
 
-    # ---- workload: Zipf-skewed launches, PIPE in flight ------------------
-    n_launches = warm_launches + timed_launches
-    draws = zipf_indices(rng, n_keys, n_launches * per_launch).astype(
-        np.int32
+    return _timed_trials(
+        dispatch, complete, rng, n_keys, per_launch, pipe,
+        warm_launches, timed_launches, profile_dir, extra,
     )
-    chunks = [
-        draws[i * per_launch : (i + 1) * per_launch]
-        for i in range(n_launches)
-    ]
-
-    # Warm (compiles are already done from populate; this settles the pipe).
-    pool = ThreadPoolExecutor(max_workers=3)
-    pending = deque()
-    for li in range(warm_launches):
-        pending.append(pool.submit(complete, *dispatch(
-            chunks[li], T0 + li * 50_000_000
-        )))
-    while pending:
-        pending.popleft().result()
-
-    import contextlib
-
-    if profile_dir:
-        from throttlecrab_tpu.tpu.profiling import trace
-
-        profiler = trace(profile_dir)
-        extra["trace_dir"] = profile_dir
-    else:
-        profiler = contextlib.nullcontext()
-
-    t_dispatch = {}
-    latencies = []
-    with profiler:
-        t_start = time.perf_counter()
-        for li in range(warm_launches, n_launches):
-            t_dispatch[li] = time.perf_counter()
-            pending.append(
-                (li, pool.submit(complete, *dispatch(
-                    chunks[li], T0 + li * 50_000_000
-                )))
-            )
-            if len(pending) > pipe:
-                j, fut = pending.popleft()
-                fut.result()
-                latencies.append(time.perf_counter() - t_dispatch[j])
-        while pending:
-            j, fut = pending.popleft()
-            fut.result()
-            latencies.append(time.perf_counter() - t_dispatch[j])
-        elapsed = time.perf_counter() - t_start
-    pool.shutdown()
-
-    decided = timed_launches * per_launch
-    lat = np.sort(np.asarray(latencies))
-    # NOTE: not comparable to the legacy path's launch_p50_ms — this is
-    # dispatch→fetch latency through a `pipe`-deep in-flight window (what a
-    # pipelined serving engine observes), not a blocking per-launch time.
-    # launch_wall_ms is the steady-state wall-clock cost per launch.
-    extra.update(
-        {
-            "elapsed_s": round(elapsed, 3),
-            "decisions": decided,
-            "fetch_latency_p50_ms": round(
-                float(lat[int(0.50 * len(lat))]) * 1e3, 3
-            ),
-            "fetch_latency_p99_ms": round(
-                float(lat[min(int(0.99 * len(lat)), len(lat) - 1)]) * 1e3, 3
-            ),
-            "launch_wall_ms": round(elapsed / timed_launches * 1e3, 3),
-        }
-    )
-    return decided / elapsed
 
 
 def run_legacy(
