@@ -1,0 +1,158 @@
+"""Two-node cluster decision throughput (DCN path, real sockets).
+
+Spawns one peer server process (cluster RPC + HTTP health), builds an
+in-process ClusterLimiter as node 0 against it, and drives Zipf-skewed
+batches through rate_limit_many — the same batch API the serving engine
+uses — reporting decisions/s for:
+
+  - local-only traffic (keys owned by node 0: cluster overhead is one
+    ownership partition, no RPC), and
+  - the natural 2-node mix (~half the keys forward to the peer over TCP
+    per batch, pipelined by the owner-routing layer).
+
+The gap between the two is the price of the DCN hop on this host (both
+processes share one vCPU here, so the mix number is a conservative
+floor — on real separate hosts the peer decides in parallel).
+
+Prints one JSON line per scenario.  --quick shrinks the workload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+CLUSTER_A = 19381
+CLUSTER_B = 19382
+HTTP_B = 19383
+NODES = f"127.0.0.1:{CLUSTER_A},127.0.0.1:{CLUSTER_B}"
+
+
+def spawn_peer():
+    env = dict(os.environ)
+    env["THROTTLECRAB_PLATFORM"] = "cpu"
+    env["THROTTLECRAB_CLUSTER_TIMEOUT_MS"] = "60000"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "throttlecrab_tpu.server",
+            "--http", "--http-port", str(HTTP_B),
+            "--cluster-nodes", NODES, "--cluster-index", "1",
+            "--store", "adaptive", "--log-level", "warn",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def wait_healthy(proc, port, deadline_s=120):
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        if proc.poll() is not None:
+            out = proc.stdout.read()
+            raise RuntimeError(f"peer exited rc={proc.returncode}: {out}")
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=1
+            ) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            time.sleep(0.3)
+    raise TimeoutError("peer did not become healthy")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--depth", type=int, default=8,
+                    help="batches per rate_limit_many window")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from throttlecrab_tpu.parallel.cluster import ClusterLimiter, node_of_key
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    peer = spawn_peer()
+    try:
+        wait_healthy(peer, HTTP_B)
+
+        local = TpuRateLimiter(capacity=1 << 18, keymap="auto")
+        cl = ClusterLimiter(local, NODES.split(","), 0, io_timeout_s=60.0)
+
+        n_keys = 20_000 if args.quick else 100_000
+        keys_all = [b"ck:%d" % i for i in range(n_keys)]
+        local_keys = [k for k in keys_all if node_of_key(k, 2) == 0]
+
+        rng = np.random.default_rng(7)
+        now0 = 1_753_000_000_000_000_000
+
+        def run(name, universe, windows):
+            # Zipf-skewed draws from the given key universe.
+            ranks = np.arange(1, len(universe) + 1, dtype=np.float64)
+            p = ranks ** -1.1
+            p /= p.sum()
+            # warm + timed
+            decided = 0
+            t_start = None
+            for w in range(windows + 2):
+                batches = []
+                for j in range(args.depth):
+                    draw = rng.choice(len(universe), args.batch, p=p)
+                    bkeys = [universe[i] for i in draw]
+                    batches.append(
+                        (bkeys, 10, 1000, 60, 1,
+                         now0 + (w * args.depth + j) * 1_000_000)
+                    )
+                res = cl.rate_limit_many(batches, wire=True)
+                assert len(res) == args.depth
+                if w == 1:
+                    t_start = time.perf_counter()
+                elif w > 1:
+                    decided += args.depth * args.batch
+            dt = time.perf_counter() - t_start
+            print(json.dumps({
+                "scenario": name,
+                "decisions_per_sec": round(decided / dt),
+                "batch": args.batch,
+                "depth": args.depth,
+                "windows": windows,
+            }), flush=True)
+
+        windows = 4 if args.quick else 12
+        run("cluster_local_only", local_keys, windows)
+        run("cluster_2node_mix", keys_all, windows)
+        stats = cl.peer_stats()[NODES.split(",")[1]]
+        print(json.dumps({
+            "scenario": "peer_stats",
+            "forwarded": int(stats["forwarded"]),
+            "failed": int(stats["failed"]),
+        }), flush=True)
+        return 0
+    finally:
+        peer.terminate()
+        try:
+            peer.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            peer.kill()
+            peer.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
