@@ -32,8 +32,12 @@ cardinality.
 
 Flags: --cpu (force CPU backend), --quick (fewer batches), --depth K
 (micro-batches per launch), --pipe P (launches in flight), --profile DIR
-(capture an xprof trace of the timed region), --legacy (the unpacked
-per-sub-batch resolve path, for comparison).
+(capture an xprof trace of trial 0's timed region), --path
+{auto,byid,packed,legacy} (launch path; --legacy is shorthand),
+--segment {auto,device,host} (where the duplicate-segment structure is
+derived on the byid path), --no-resident (skip the kernel-ceiling
+measurement), --pallas (route row movement through the Pallas kernels —
+a documented NO-GO on this tunnel's remote compiler).
 
 Hardening: the accelerator on this host is reached through a tunnel whose
 relay can wedge (a process killed mid-claim leaves every later device query
@@ -123,7 +127,6 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--json-extra", action="store_true")
     ap.add_argument("--depth", type=int, default=None,
                     help="micro-batches per device launch (default: 256 "
                          "on TPU where the ~300ms fixed per-launch relay "
