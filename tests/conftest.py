@@ -25,3 +25,17 @@ if not os.environ.get("THROTTLECRAB_TPU_TEST_REAL"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def require_devices(n: int) -> None:
+    """Skip the calling test when the backend exposes fewer than `n`
+    devices — only happens under THROTTLECRAB_TPU_TEST_REAL on
+    single-chip hardware (the default CPU harness always has 8 virtual
+    devices).  make_mesh(n) raises in that situation rather than
+    silently shrinking the mesh."""
+    import jax
+    import pytest
+
+    have = len(jax.devices())
+    if have < n:
+        pytest.skip(f"needs {n} devices, backend has {have}")

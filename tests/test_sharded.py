@@ -9,6 +9,8 @@ mesh.  Mirrors the reference's store-agnostic shared suite
 
 import jax
 import numpy as np
+
+from conftest import require_devices
 import pytest
 
 from throttlecrab_tpu.core.rate_limiter import RateLimiter
@@ -22,7 +24,7 @@ T0 = 1_700_000_000 * NS
 
 @pytest.fixture(scope="module")
 def mesh():
-    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    require_devices(8)  # single-chip THROTTLECRAB_TPU_TEST_REAL runs skip
     return make_mesh(8)
 
 

@@ -2,6 +2,8 @@
 all state ephemeral by design — SURVEY §5 checkpoint row)."""
 
 import numpy as np
+
+from conftest import require_devices
 import pytest
 
 from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
@@ -171,6 +173,7 @@ def _check_continuity(lim):
 
 
 def test_sharded_snapshot_round_trip(tmp_path):
+    require_devices(4)
     from throttlecrab_tpu.parallel.sharded import (
         ShardedTpuRateLimiter,
         make_mesh,
@@ -194,6 +197,7 @@ def test_sharded_snapshot_restores_across_shard_counts(tmp_path):
     """A 8-shard snapshot restores onto 2 shards (and the reverse):
     shard topology is not part of the snapshot contract — keys re-route
     through the target's own hash."""
+    require_devices(8)
     from throttlecrab_tpu.parallel.sharded import (
         ShardedTpuRateLimiter,
         make_mesh,
@@ -214,6 +218,7 @@ def test_sharded_snapshot_restores_across_shard_counts(tmp_path):
 
 
 def test_sharded_snapshot_restores_to_single_device(tmp_path):
+    require_devices(4)
     from throttlecrab_tpu.parallel.sharded import (
         ShardedTpuRateLimiter,
         make_mesh,
@@ -232,6 +237,7 @@ def test_sharded_snapshot_restores_to_single_device(tmp_path):
 
 
 def test_single_device_snapshot_restores_to_sharded(tmp_path):
+    require_devices(4)
     from throttlecrab_tpu.parallel.sharded import (
         ShardedTpuRateLimiter,
         make_mesh,
@@ -250,6 +256,7 @@ def test_single_device_snapshot_restores_to_sharded(tmp_path):
 
 
 def test_sharded_restore_drops_expired(tmp_path):
+    require_devices(2)
     from throttlecrab_tpu.parallel.sharded import (
         ShardedTpuRateLimiter,
         make_mesh,

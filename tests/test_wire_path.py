@@ -6,6 +6,8 @@ saturated at i32::MAX — the reference's own type-boundary truncation
 """
 
 import numpy as np
+
+from conftest import require_devices
 import pytest
 
 from throttlecrab_tpu.parallel.sharded import (
@@ -99,6 +101,7 @@ def test_wire_param_conflict_fallback_stays_wire():
 
 
 def test_sharded_wire_batch_matches_exact():
+    require_devices(4)
     rng = np.random.default_rng(31)
     batches = random_batches(rng, 4, True)
     mesh_a = make_mesh(4)
@@ -118,6 +121,7 @@ def test_sharded_many_matches_sequential(wire):
     """ShardedTpuRateLimiter.rate_limit_many (one mesh launch for K
     sub-batches) == K sequential rate_limit_batch calls, including the
     psum-reduced counters."""
+    require_devices(4)
     rng = np.random.default_rng(43)
     batches = random_batches(rng, 6, False)
 
@@ -143,6 +147,7 @@ def test_sharded_many_matches_sequential(wire):
 def test_sharded_many_cross_batch_state_carries():
     """Burst 10, 4 sub-batches x 4 hits on one key through the mesh scan:
     exactly 10 allowed in arrival order across the window."""
+    require_devices(4)
     batches = [(["hot"] * 4, 10, 100, 3600, 1, T0 + k) for k in range(4)]
     lim = ShardedTpuRateLimiter(capacity_per_shard=64, mesh=make_mesh(4))
     results = lim.rate_limit_many(batches)
@@ -156,6 +161,7 @@ def test_engine_backlog_drains_through_sharded_scan(monkeypatch):
     launch when shards > 1 — the case that used to silently degrade to
     one-batch-per-launch.  The engine enters through dispatch_many (the
     double-buffered flush loop)."""
+    require_devices(4)
     import asyncio
 
     from throttlecrab_tpu.server.engine import BatchingEngine
@@ -193,6 +199,7 @@ def test_engine_backlog_drains_through_sharded_scan(monkeypatch):
 
 
 def test_sharded_many_param_conflict_falls_back():
+    require_devices(2)
     batches = [
         (["p", "p"], [5, 2], [10, 10], [60, 60], 1, T0),
         (["p"], 2, 10, 60, 1, T0 + 1),

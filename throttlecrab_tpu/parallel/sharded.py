@@ -67,10 +67,19 @@ def shard_of_key(key: bytes, n_shards: int) -> int:
 
 
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
-    """A 1-D ``(shard,)`` mesh over the first ``n_devices`` devices."""
+    """A 1-D ``(shard,)`` mesh over the first ``n_devices`` devices.
+
+    Raises when fewer devices exist than requested — silently shrinking
+    the mesh would give the caller fewer shards (and less capacity/
+    throughput) than they provisioned for."""
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"requested a {n_devices}-device mesh but the backend "
+                    f"exposes {len(devices)}"
+                )
             devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (AXIS,))
 
