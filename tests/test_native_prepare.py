@@ -200,3 +200,34 @@ def test_wire_window_differential_random():
         if round_i % 4 == 3:
             now += 10 * NS
             assert lim_a.sweep(now) == lim_b.sweep(now)
+
+
+def test_prepare_batch_flags_big_tolerance():
+    """tol >= 2^61 must raise PREP_BIGTOL (the fits_cur_wire half the C++
+    prep can certify) without tripping the degeneracy flag — the limiter
+    then serves the window through the 4-plane compact output."""
+    from throttlecrab_tpu.native import (
+        PREP_BIGTOL,
+        PREP_DEGEN,
+        NativeKeyMap,
+        toolchain_available,
+    )
+
+    if not toolchain_available():
+        import pytest
+
+        pytest.skip("no C++ toolchain")
+    km = NativeKeyMap(16)
+    packed, status, flags = km.prepare_batch(
+        b"big", np.array([0, 3], np.int64),
+        np.array([[3_000_000_000, 1, 1, 1]], np.int64),
+    )
+    assert status[0] == 0
+    assert flags & PREP_BIGTOL
+    assert not (flags & PREP_DEGEN)
+
+    packed, status, flags = km.prepare_batch(
+        b"ok", np.array([0, 2], np.int64),
+        np.array([[10, 100, 60, 1]], np.int64),
+    )
+    assert status[0] == 0 and not (flags & PREP_BIGTOL)
