@@ -358,3 +358,138 @@ class TestRandomizedEquivalence:
                 f"churn{seed}-step{step}",
             )
             now += int(rng.randint(0, 3 * NS))
+
+
+class TestWrappedBurstLimit:
+    """Differential-fuzz regression (round 4): a tolerance big enough
+    that now + tol overflows i64 must WRAP (reference burst_limit
+    semantics, rate_limiter.rs via core wrap_i64) — the saturating add
+    reported ~1.2e8 remaining where the reference reports 0."""
+
+    # seed-37 fuzz case: em*(burst-1) wraps to a huge POSITIVE tol
+    # (7.66e18), so now + tol > i64::MAX while nothing is degenerate.
+    BURST = 169_785_306_178
+    COUNT = 559_666
+    PERIOD = 1 << 25
+    NOW = 1_753_700_000 * NS
+
+    def params(self):
+        from throttlecrab_tpu.tpu.limiter import derive_params
+
+        em, tol, invalid = derive_params(
+            np.array([self.BURST], np.int64),
+            np.array([self.COUNT], np.int64),
+            np.array([self.PERIOD], np.int64),
+        )
+        assert not invalid[0] and tol[0] > 0
+        assert self.NOW + int(tol[0]) > (1 << 63) - 1  # really overflows
+        return em, tol
+
+    def oracle(self, qty):
+        from throttlecrab_tpu.core.rate_limiter import RateLimiter as Oracle
+        from throttlecrab_tpu.core.store.periodic import PeriodicStore
+
+        lim = Oracle(PeriodicStore())
+        return lim.rate_limit(
+            "w", self.BURST, self.COUNT, self.PERIOD, qty, self.NOW
+        )
+
+    def test_exact_path_wraps(self):
+        """The default (with_degen=True) kernel must wrap burst_limit."""
+        em, tol = self.params()
+        tpu = TpuRateLimiter(capacity=64)
+        res = tpu.rate_limit_batch(
+            ["w"], self.BURST, self.COUNT, self.PERIOD, 3, self.NOW
+        )
+        allowed, want = self.oracle(3)
+        assert bool(res.allowed[0]) == allowed
+        assert int(res.remaining[0]) == want.remaining == 0
+        assert int(res.reset_after_ns[0]) == want.reset_after_ns
+
+    def test_degenerate_batch_wraps(self):
+        """A qty-0 batchmate routes the same key through the degenerate
+        3-view kernel; remaining must still wrap to 0."""
+        tpu = TpuRateLimiter(capacity=64)
+        res = tpu.rate_limit_batch(
+            ["w", "probe"],
+            [self.BURST, 5],
+            [self.COUNT, 10],
+            [self.PERIOD, 60],
+            [3, 0],
+            self.NOW,
+        )
+        allowed, want = self.oracle(3)
+        assert bool(res.allowed[0]) == allowed
+        assert int(res.remaining[0]) == want.remaining == 0
+
+    def test_certified_wire_path_wraps(self):
+        """wire=True on non-degenerate traffic compiles the certificate
+        in (with_degen=False, limiter.py) — the CERTIFIED kernel must
+        wrap too, and every wire field must match the oracle's."""
+        from throttlecrab_tpu.tpu.limiter import has_degenerate
+
+        em, tol = self.params()
+        assert not has_degenerate(
+            np.array([True]), em, tol, np.array([3], np.int64)
+        )
+        tpu = TpuRateLimiter(capacity=64)
+        res = tpu.rate_limit_batch(
+            ["w"], self.BURST, self.COUNT, self.PERIOD, 3, self.NOW,
+            wire=True,
+        )
+        allowed, want = self.oracle(3)
+        assert bool(res.allowed[0]) == allowed
+        assert int(res.remaining[0]) == want.remaining == 0
+        assert int(res.reset_after_s[0]) == min(
+            want.reset_after_ns // NS, (1 << 31) - 1
+        )
+        assert int(res.retry_after_s[0]) == min(
+            want.retry_after_ns // NS, (1 << 31) - 1
+        )
+
+
+@pytest.mark.parametrize("seed", range(1000, 1012))
+def test_random_scenarios_wild_params(seed):
+    """Differential fuzz with occasionally-extreme parameters (bursts to
+    2^40, counts to 2^20, periods to 2^25 s): the class that caught the
+    wrapped-burst-limit bug.  Virtual clocks near 0 included."""
+    rng = np.random.RandomState(seed)
+    native = bool(seed % 2)
+    from throttlecrab_tpu.core.rate_limiter import RateLimiter
+    from throttlecrab_tpu.core.store.periodic import PeriodicStore
+
+    try:
+        tpu = TpuRateLimiter(
+            capacity=128, keymap="native" if native else "python"
+        )
+    except RuntimeError:
+        pytest.skip("native keymap unavailable")
+    oracle = RateLimiter(PeriodicStore())
+    pool = [
+        (f"w{seed}k{i}".encode() if native else f"w{seed}k{i}")
+        for i in range(int(rng.randint(2, 12)))
+    ]
+    params = {}
+    for k in pool:
+        wild = rng.rand() < 0.2
+        params[k] = (
+            int(rng.randint(1, 1 << 40)) if wild else int(rng.randint(1, 30)),
+            int(rng.randint(1, 1 << 20)) if wild else int(rng.randint(1, 3000)),
+            int(rng.choice([1, 10, 3600, 1 << 25])) if wild
+            else int(rng.choice([1, 10, 60, 3600])),
+        )
+    now = BASE if seed % 3 else int(rng.randint(0, 10 * NS))
+    for step in range(10):
+        n = int(rng.randint(1, 28))
+        keys = [pool[rng.randint(len(pool))] for _ in range(n)]
+        b = np.array([params[k][0] for k in keys], np.int64)
+        c = np.array([params[k][1] for k in keys], np.int64)
+        p = np.array([params[k][2] for k in keys], np.int64)
+        q = np.array([int(rng.randint(0, 5)) for _ in keys], np.int64)
+        qm: dict = {}
+        for i, k in enumerate(keys):
+            q[i] = qm.setdefault(k, int(q[i]))
+        res = tpu.rate_limit_batch(keys, b, c, p, q, now)
+        exp = oracle_batch(oracle, keys, b, c, p, q, now)
+        assert_batch_equal(res, exp, f"wild seed{seed} step{step}")
+        now += int(rng.randint(0, 3 * NS))

@@ -239,7 +239,12 @@ def _request_outputs(t, inc, emission, tol, now):
     allow_at = sat_sub(new_tat, tol)
     allowed = now >= allow_at
     cur = jnp.where(allowed, new_tat, t)
-    burst_limit = sat_add(now, tol)
+    # WRAPPING add, not saturating: the reference computes burst_limit
+    # with a wrapping i64 sum (rate_limiter.rs / core oracle
+    # `wrap_i64(now + tol)`), so a tolerance big enough to overflow
+    # now + tol wraps negative and `remaining` collapses to 0.  XLA's
+    # plain i64 add has exactly those two's-complement semantics.
+    burst_limit = now + tol
     room = sat_sub(burst_limit, cur)
     remaining = jnp.where(
         emission > 0, jnp.maximum(div_trunc(room, emission), 0), 0
@@ -339,7 +344,15 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False):
         t0, s_mul(jnp.minimum(m_raw, rank + 1), inc)
     )
 
-    burst_limit = s_add(now, tol)
+    # WRAPPING add (see _request_outputs): the reference's burst_limit
+    # wraps on i64 overflow; a saturating add here made `remaining`
+    # huge instead of 0 for wrapped-positive tolerances near i64::MAX
+    # (caught by differential fuzzing, round 4).  The certified fast
+    # path does NOT bound tol, so the overflow case is reachable there
+    # too; for every non-overflowing input the plain add is identical
+    # (and cheaper).  `num` above must STAY saturating — the closed
+    # form's allow condition matches the oracle's saturating chain.
+    burst_limit = now + tol
     room_main = sat_sub(burst_limit, cur_main)
     remaining_main = jnp.where(
         em > 0, jnp.maximum(div_trunc(room_main, em), 0), 0
