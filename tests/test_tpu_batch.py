@@ -480,6 +480,13 @@ def test_random_scenarios_wild_params(seed):
         )
     now = BASE if seed % 3 else int(rng.randint(0, 10 * NS))
     for step in range(10):
+        if rng.rand() < 0.25:
+            # Interleave an expiry sweep (slot recycling); the oracle's
+            # store expires on read, so only the engine needs the call.
+            # Occasionally jump time so the sweep actually collects.
+            if rng.rand() < 0.5:
+                now += int(rng.randint(1, 7200)) * NS
+            tpu.sweep(now)
         n = int(rng.randint(1, 28))
         keys = [pool[rng.randint(len(pool))] for _ in range(n)]
         b = np.array([params[k][0] for k in keys], np.int64)
