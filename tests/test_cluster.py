@@ -495,3 +495,82 @@ def test_cluster_wire_window_delegates_when_local():
         cl2.dispatch_wire_window(make_frames([local_key, remote_key]), T0)
         is None
     )
+
+
+def test_cluster_differential_vs_oracle():
+    """Random traffic (incl. wild parameter draws) through an in-process
+    ClusterLimiter with a real spawned peer must match the scalar oracle
+    value-for-value — the RPC encode/decode path carries exact i64
+    params and exact wire results for keys owned by either node."""
+    import numpy as np
+
+    from test_tpu_batch import oracle_batch
+    from throttlecrab_tpu.core.rate_limiter import RateLimiter
+    from throttlecrab_tpu.core.store.periodic import PeriodicStore
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    I32 = (1 << 31) - 1
+    b_proc = spawn_node(1, HTTP_B)
+    try:
+        wait_healthy(b_proc, HTTP_B)
+        local = TpuRateLimiter(capacity=1 << 12, keymap="auto")
+        cl = ClusterLimiter(local, NODES.split(","), 0, io_timeout_s=60.0)
+        for seed in range(3):
+            rng = np.random.RandomState(9000 + seed)
+            oracle = RateLimiter(PeriodicStore())
+            pool = [b"cd%dk%d" % (seed, i) for i in range(8)]
+            params = {}
+            for k in pool:
+                wild = rng.rand() < 0.2
+                params[k] = (
+                    int(rng.randint(1, 1 << 40)) if wild
+                    else int(rng.randint(1, 30)),
+                    int(rng.randint(1, 1 << 20)) if wild
+                    else int(rng.randint(1, 3000)),
+                    int(rng.choice([1, 10, 3600, 1 << 25])) if wild
+                    else int(rng.choice([1, 10, 60, 3600])),
+                )
+            now = 1_753_700_000 * 10**9 + seed * 3600 * 10**9
+            for step in range(5):
+                n = int(rng.randint(1, 20))
+                keys = [pool[rng.randint(len(pool))] for _ in range(n)]
+                b = np.array([params[k][0] for k in keys], np.int64)
+                c = np.array([params[k][1] for k in keys], np.int64)
+                p = np.array([params[k][2] for k in keys], np.int64)
+                q = np.array(
+                    [int(rng.randint(0, 5)) for _ in keys], np.int64
+                )
+                qm: dict = {}
+                for i, k in enumerate(keys):
+                    q[i] = qm.setdefault(k, int(q[i]))
+                res = cl.rate_limit_many(
+                    [(keys, b, c, p, q, now)], wire=True
+                )[0]
+                exp = oracle_batch(oracle, keys, b, c, p, q, now)
+                ok = exp["status"] == 0
+                ctx = f"seed{seed} step{step}"
+                np.testing.assert_array_equal(
+                    res.status, exp["status"], err_msg=ctx
+                )
+                np.testing.assert_array_equal(
+                    res.allowed[ok], exp["allowed"][ok], err_msg=ctx
+                )
+                np.testing.assert_array_equal(
+                    res.remaining[ok],
+                    np.minimum(exp["remaining"], I32)[ok], err_msg=ctx,
+                )
+                np.testing.assert_array_equal(
+                    res.reset_after_s[ok],
+                    np.minimum(exp["reset"] // 10**9, I32)[ok],
+                    err_msg=ctx,
+                )
+                now += int(rng.randint(0, 10**9))
+        stats = cl.peer_stats()[NODES.split(",")[1]]
+        assert stats["forwarded"] > 0 and stats["failed"] == 0
+    finally:
+        b_proc.terminate()
+        try:
+            b_proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            b_proc.kill()
+            b_proc.wait()
