@@ -99,6 +99,18 @@ def main() -> int:
         limiter = TpuRateLimiter(capacity=1 << 18, keymap="auto")
         bench_scenario(limiter, name, ids, B, iters, params, now0)
 
+    # Workload-pattern rps sweep: the configured request-rate knob
+    # (count_per_period = 100/1000/10000) cycled sequentially over 100
+    # hot keys, like the reference's workload_patterns rps_* group
+    # (store_performance.rs:263-291).
+    rps_ids = np.arange(total, dtype=np.int64) % 100
+    for rate in (100, 1000, 10_000):
+        limiter = TpuRateLimiter(capacity=1 << 12, keymap="auto")
+        bench_scenario(
+            limiter, f"workload_rps_{rate}", rps_ids, B, iters,
+            (100, rate, 60), now0,
+        )
+
     # Cleanup-policy comparison on the zipfian workload
     # (store comparison group in the reference bench).
     from throttlecrab_tpu.server.engine import BatchingEngine  # noqa: F401

@@ -1,0 +1,132 @@
+"""Is the tunnel full-duplex?  Measure h2d/d2h overlap (or its absence).
+
+The launch cost model (docs/tpu-launch-profile.md, cited by
+tpu/kernel.py and bench.py) rests on one claim: the relay link is
+SERIALIZED — host→device uploads, device compute, and device→host
+fetches share one ~15-50 MB/s pipe and do not overlap, so end-to-end
+throughput ≈ link_rate / (h2d_bytes + d2h_bytes per request).  This
+probe measures that claim directly:
+
+  1. h2d alone      — time N uploads of M MB.
+  2. d2h alone      — time N fetches of M MB (never-fetched buffers).
+  3. h2d ∥ d2h      — run both streams concurrently from two threads.
+
+On a full-duplex link the concurrent wall time ≈ max(h2d, d2h); on a
+serialized link it ≈ h2d + d2h.  Round 4 measured the serialized case:
+concurrent wall time within a few percent of the sum, h2d ~40-50 MB/s,
+first-fetch d2h ~10-30 MB/s (single-stream), establishing the
+bytes-per-request budget that drove the by-id (4-8 B/request up) and
+compact="cur" (8 B/request down) launch modes.
+
+Usage: python scripts/probe_duplex.py [--cpu] [--mb M] [--n N]
+Run on a healthy tunnel (never timeout-kill it mid-claim).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import throttlecrab_tpu  # noqa: F401  (repo-root import side effects)
+import jax
+
+if "--cpu" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+
+def arg(flag: str, default: int) -> int:
+    if flag in sys.argv:
+        return int(sys.argv[sys.argv.index(flag) + 1])
+    return default
+
+
+MB = arg("--mb", 8)
+N = arg("--n", 6)
+
+dev = jax.devices()[0]
+print(f"device: {dev}  ({MB} MB x {N} buffers per stream)", flush=True)
+
+n_el = MB * (1 << 20) // 4
+mk = jax.jit(lambda x: x * 3 + 1)
+
+
+def fresh_device_outputs(n):
+    """n distinct never-fetched device buffers (fetch cost is paid on
+    first materialization; reusing a fetched buffer would measure a
+    cache, not the link)."""
+    outs = []
+    for i in range(n):
+        seed = jax.device_put(np.arange(n_el, dtype=np.int32) + i, dev)
+        outs.append(mk(seed))
+    for o in outs:
+        o.block_until_ready()
+    return outs
+
+
+def host_buffers(n):
+    return [np.arange(n_el, dtype=np.int32) + 7 * i for i in range(n)]
+
+
+def run_h2d(bufs):
+    t = time.perf_counter()
+    put = [jax.device_put(b, dev) for b in bufs]
+    for p in put:
+        p.block_until_ready()
+    return time.perf_counter() - t
+
+
+def run_d2h(outs):
+    t = time.perf_counter()
+    for o in outs:
+        np.asarray(o)
+    return time.perf_counter() - t
+
+
+def report(label, secs, mbytes):
+    print(f"{label:<18} {secs * 1e3:8.1f} ms   {mbytes / secs:7.1f} MB/s",
+          flush=True)
+
+
+# Warm-up: the first timing block in a process reads ~2x slow through the
+# relay (docs/tpu-launch-profile.md); one throwaway round of each.
+run_h2d(host_buffers(2))
+run_d2h(fresh_device_outputs(2))
+
+total_mb = MB * N
+
+t_up = run_h2d(host_buffers(N))
+report("h2d alone", t_up, total_mb)
+
+t_down = run_d2h(fresh_device_outputs(N))
+report("d2h alone", t_down, total_mb)
+
+# Concurrent streams: prepare both sides first so neither setup is timed.
+outs = fresh_device_outputs(N)
+bufs = host_buffers(N)
+pool = ThreadPoolExecutor(2)
+t = time.perf_counter()
+f_up = pool.submit(run_h2d, bufs)
+f_down = pool.submit(run_d2h, outs)
+f_up.result(), f_down.result()
+t_both = time.perf_counter() - t
+report("h2d ∥ d2h", t_both, 2 * total_mb)
+
+serial = t_up + t_down
+overlap = max(t_up, t_down)
+print(
+    f"\nserialized-link prediction {serial * 1e3:.1f} ms, full-duplex "
+    f"prediction {overlap * 1e3:.1f} ms, measured {t_both * 1e3:.1f} ms",
+    flush=True,
+)
+ratio = (t_both - overlap) / max(serial - overlap, 1e-9)
+print(
+    f"serialization ratio {ratio:.2f}  "
+    "(1.0 = fully serialized, 0.0 = full duplex)",
+    flush=True,
+)

@@ -1,0 +1,53 @@
+"""Patient TPU-tunnel probe: claim the device and WAIT, never killed.
+
+The axon relay wedge (docs/tpu-launch-profile.md, "The cost model of the
+tunnel") presents as an indefinite silent hang on the first device touch.
+bench.py's 150 s probe answers "is the tunnel healthy NOW"; this script
+answers "does the wedge ever clear" — it sits in jax.devices() for as
+long as it takes, heartbeating to stderr so an outside poller can see it
+is alive, and on success runs one tiny kernel launch to prove the claim
+is usable end-to-end.  Run under `nohup ... &` and poll the log; never
+timeout-kill it (a killed mid-claim process is what poisons the relay).
+"""
+
+import sys
+import threading
+import time
+
+T0 = time.time()
+
+
+def log(msg: str) -> None:
+    print(f"[{time.time() - T0:8.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def heartbeat() -> None:
+    while True:
+        time.sleep(30)
+        log("still waiting on the relay...")
+
+
+def main() -> int:
+    threading.Thread(target=heartbeat, daemon=True).start()
+    log("importing jax")
+    import jax
+
+    log("touching jax.devices() — this blocks while the relay is wedged")
+    devs = jax.devices()
+    log(f"CLAIMED: {devs[0].platform} x{len(devs)} ({devs[0]})")
+
+    import jax.numpy as jnp
+
+    x = jnp.arange(8, dtype=jnp.int32)
+    y = jax.jit(lambda a: a * 2 + 1)(x)
+    import numpy as np
+
+    got = np.asarray(y)
+    log(f"kernel sanity: {got.tolist()}")
+    assert (got == np.arange(8) * 2 + 1).all()
+    log("TUNNEL HEALTHY")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

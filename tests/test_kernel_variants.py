@@ -271,3 +271,54 @@ def test_fits_cur_wire_bounds():
     assert not fits_cur_wire(np.array([1 << 61], np.int64), BASE)
     assert not fits_cur_wire(np.array([1], np.int64), 1 << 61)
     assert fits_cur_wire(np.array([], np.int64), BASE)  # empty batch
+
+
+def test_byid_word_path_masks_unresolved_slot():
+    """Both by-id kernels must treat an id row carrying slot -1 (the
+    resolve_all marker for a full table) as invalid, even when the
+    request word's valid bit is set — never clip it onto slot 0 and
+    corrupt another key's bucket (ADVICE r4)."""
+    from throttlecrab_tpu.tpu.kernel import (
+        IDROW_WIDTH,
+        gcra_scan_byid,
+        gcra_scan_ids,
+        pack_id_rows,
+        unpack_state,
+    )
+
+    # Distinct emission for the unresolved id: a clipped-to-slot-0 write
+    # from it would land a visibly different TAT than id 0's own.
+    em = np.array([600_000_000, 5_000_000_000], np.int64)
+    tol = em * 8
+    rows = pack_id_rows(np.array([0, -1], np.int32), em, tol)
+    assert rows.shape[1] == IDROW_WIDTH
+
+    def word(idx, rank=0, is_last=True, valid=True):
+        meta = rank | (int(is_last) << 14) | (int(valid) << 15)
+        return np.int64(idx | (meta << 32))
+
+    for scan, reqs in (
+        (gcra_scan_byid, np.array([[word(0), word(1)]], np.int64)),
+        (gcra_scan_ids, np.array([[0, 1]], np.int32)),
+    ):
+        state = pack_state(
+            jnp.zeros((64,), jnp.int64),
+            jnp.full((64,), EMPTY_EXPIRY, jnp.int64),
+        )
+        state, out = scan(
+            state, jnp.asarray(rows), jnp.asarray(reqs),
+            np.array([BASE], np.int64), 2,
+        )
+        out = np.asarray(out)
+        tat, _ = unpack_state(np.asarray(state))
+        tat = np.asarray(tat)
+        # id 0 decided normally against slot 0...
+        assert out[0, 0, 0] == 1
+        # ...and the unresolved id 1 is invalid: denied, no state write.
+        assert out[0, 0, 1] == 0
+        # Slot 0 holds exactly id 0's own advance (first touch, q=2:
+        # now - em + 2*em); a clipped write from id 1 would differ by em.
+        assert tat[0] == BASE + em[0]
+        # No other REAL slot is touched (suppressed writes are absorbed
+        # by the scratch tail at the high end of the state array).
+        assert (tat[1:32] == 0).all()

@@ -42,6 +42,38 @@ def test_snapshot_round_trip(tmp_path, keymap):
     assert r.remaining == 3  # one of five tokens was used pre-snapshot
 
 
+def test_restore_carries_cur_state_certificate(tmp_path):
+    """A snapshot holding a TAT >= 2^62 (written by a big-tolerance
+    launch) must restore with table.cur_safe False — restored state is
+    foreign and the cur wire mode's cross-launch certificate only holds
+    for proven-safe values — while a normal snapshot restores safe."""
+    big = (3_000_000_000, 1, 1, 3_000_000_000)  # tol ~3e18, inc ~3e18
+    lim = TpuRateLimiter(capacity=256)
+    res = lim.rate_limit_batch(["k"], *big, T0, wire=True)
+    assert bool(res.allowed[0]) and lim.table.cur_safe is False
+    path = tmp_path / "poison.npz"
+    save_snapshot(lim, path)
+
+    lim2 = TpuRateLimiter(capacity=256)
+    assert load_snapshot(lim2, path, now_ns=T0 + NS) == 1
+    assert lim2.table.cur_safe is False
+    h = lim2.dispatch_many([(["k"], 10, 100, 60, 1, T0 + NS)], wire=True)
+    assert not getattr(h, "_cur", True)
+    assert not bool(h.fetch()[0].allowed[0])
+
+    safe = TpuRateLimiter(capacity=256)
+    safe.rate_limit_batch(["a", "b"], 5, 10, 3600, 1, T0, wire=True)
+    assert safe.table.cur_safe is True
+    path2 = tmp_path / "safe.npz"
+    save_snapshot(safe, path2)
+    lim3 = TpuRateLimiter(capacity=256)
+    load_snapshot(lim3, path2, now_ns=T0 + NS)
+    assert lim3.table.cur_safe is True
+    h = lim3.dispatch_many([(["a"], 5, 10, 3600, 1, T0 + NS)], wire=True)
+    assert getattr(h, "_cur", False)
+    h.fetch()
+
+
 def test_restore_drops_expired_entries(tmp_path):
     path = tmp_path / "snap.npz"
     lim = TpuRateLimiter(capacity=64)

@@ -269,6 +269,130 @@ def test_cur_mode_falls_back_on_big_tolerance():
     np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
 
 
+def _fill_bucket_past_cur_bound(lim, key, t0):
+    """Store a TAT >= 2^62 for `key`: tol ~3e18 >= 2^61 (4-plane path)
+    and quantity big enough that the allowed write lands near now + tol.
+    Returns the stored-state poisoning launch's params."""
+    big = (3_000_000_000, 1, 1, 3_000_000_000)  # burst, count, period, qty
+    res = lim.rate_limit_batch([key], *big, t0, wire=True)
+    assert bool(res.allowed[0])  # the poisoning write actually happened
+    return big
+
+
+def test_cur_mode_respects_stored_state_across_launches():
+    """Cross-launch half of the cur certificate (ADVICE r4): a prior
+    big-tolerance launch persists a TAT >= 2^62; a later normal-tolerance
+    launch on the same key must NOT take the cur path (its `cur*2+allowed`
+    word would wrap and finish_cur would report retry_after 0 / huge
+    remaining for denied lanes).  Twin limiter runs the same traffic
+    entirely on the exact 4-plane path."""
+    lim = TpuRateLimiter(capacity=256)
+    twin = TpuRateLimiter(capacity=256)
+    big = _fill_bucket_past_cur_bound(lim, "k", T0)
+    twin.rate_limit_batch(["k"], *big, T0, wire=True)
+    assert lim.table.cur_safe is False
+
+    t1 = T0 + NS
+    handle = lim.dispatch_many(
+        [(["k", "other", "k"], 10, 100, 60, 1, t1)], wire=True
+    )
+    assert not getattr(handle, "_cur", True), (
+        "poisoned stored state must disable the cur wire mode"
+    )
+    res = handle.fetch()[0]
+    ref = twin.rate_limit_batch(
+        ["k", "other", "k"], 10, 100, 60, 1, t1, wire=True
+    )
+    assert not bool(res.allowed[0])  # bucket full for ~95 years
+    np.testing.assert_array_equal(res.allowed, ref.allowed)
+    np.testing.assert_array_equal(res.remaining, ref.remaining)
+    np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
+    np.testing.assert_array_equal(res.retry_after_s, ref.retry_after_s)
+    # The denied lanes' oracle values are the saturated ones the wrapped
+    # cur word would have corrupted (retry 0 / remaining up to i32max).
+    assert ref.retry_after_s[0] == I32_MAX
+
+
+def test_cur_mode_recovers_on_fresh_table_only():
+    """cur_safe is sticky: certified traffic after the poisoning launch
+    stays on the 4-plane path (the big TAT never expires), while a fresh
+    limiter takes cur for identical traffic."""
+    lim = TpuRateLimiter(capacity=256)
+    _fill_bucket_past_cur_bound(lim, "k", T0)
+    h = lim.dispatch_many([(["a", "b"], 10, 100, 60, 1, T0 + NS)], wire=True)
+    assert not getattr(h, "_cur", True)
+    h.fetch()
+
+    fresh = TpuRateLimiter(capacity=256)
+    h2 = fresh.dispatch_many(
+        [(["a", "b"], 10, 100, 60, 1, T0 + NS)], wire=True
+    )
+    assert getattr(h2, "_cur", False)
+    h2.fetch()
+
+
+def test_invalid_or_degen_lanes_do_not_poison_cur_safe():
+    """Only a VALID lane with tol >= 2^61 can store a TAT >= 2^62 —
+    rejected requests never write (their u32-wrapped garbage tolerance
+    is meaningless), and quantity-0/emission-0 degens obey the same
+    write bound — so neither may clear the sticky cur_safe flag or
+    forfeit cur mode for later certified traffic."""
+    lim = TpuRateLimiter(capacity=256)
+    # burst=0 lane is rejected (status!=0) with wrapped tol ~4.3e18.
+    r = lim.rate_limit_batch(
+        ["a", "bad", "b"], [10, 0, 10], [100, 1, 100], [60, 1, 60], 1,
+        T0, wire=True,
+    )
+    assert r.status[1] != 0 and r.allowed[0] and r.allowed[2]
+    assert lim.table.cur_safe is True
+
+    # Valid quantity-0 probe (degenerate, writes nothing beyond bound).
+    lim.rate_limit_batch(["a"], 10, 100, 60, 0, T0, wire=True)
+    assert lim.table.cur_safe is True
+
+    # Certified traffic afterwards still takes the cur path.
+    h = lim.dispatch_many([(["a", "b"], 10, 100, 60, 1, T0 + NS)], wire=True)
+    assert getattr(h, "_cur", False)
+    h.fetch()
+
+    # And a window CONTAINING a rejected lane still uses cur itself
+    # (invalid lanes are don't-care in the wire output).
+    h2 = lim.dispatch_many(
+        [(["a", "bad2"], [10, 0], [100, 1], [60, 1], 1, T0 + 2 * NS)],
+        wire=True,
+    )
+    assert getattr(h2, "_cur", False)
+    res = h2.fetch()[0]
+    assert res.status[1] != 0
+    assert lim.table.cur_safe is True
+
+
+def test_sharded_cur_mode_respects_stored_state():
+    """Same cross-launch guard on the mesh: the sharded table's cur_safe
+    drops after a big-tolerance launch and dispatch_many stays on the
+    4-plane path with oracle-equal wire values."""
+    require_devices(2)
+    mesh = make_mesh(2)
+    lim = ShardedTpuRateLimiter(capacity_per_shard=128, mesh=mesh)
+    seq = ShardedTpuRateLimiter(capacity_per_shard=128, mesh=mesh)
+    big = (3_000_000_000, 1, 1, 3_000_000_000)
+    r = lim.rate_limit_batch(["k"], *big, T0, wire=True)
+    assert bool(r.allowed[0])
+    seq.rate_limit_batch(["k"], *big, T0, wire=True)
+    assert lim.table.cur_safe is False
+
+    t1 = T0 + NS
+    handle = lim.dispatch_many(
+        [(["k", "other"], 10, 100, 60, 1, t1)], wire=True
+    )
+    res = handle.fetch()[0]
+    ref = seq.rate_limit_batch(["k", "other"], 10, 100, 60, 1, t1, wire=True)
+    np.testing.assert_array_equal(res.allowed, ref.allowed)
+    np.testing.assert_array_equal(res.remaining, ref.remaining)
+    np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
+    np.testing.assert_array_equal(res.retry_after_s, ref.retry_after_s)
+
+
 def test_native_wire_window_cur_matches_python_path():
     """dispatch_wire_window (native prep + cur mode) returns the same
     wire values as rate_limit_batch for identical certified traffic."""

@@ -239,6 +239,8 @@ class NativeKeyMap:
         # device-resident id rows (table.ResidentIdRows) pin the value
         # they were built at and refuse to serve once it moves.
         self.mutations = 0
+        # Failure count of the most recent resolve_all (0 before any).
+        self.last_resolve_failures = 0
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -345,15 +347,35 @@ class NativeKeyMap:
         )
         return out, int(n_full)
 
-    def resolve_all(self) -> np.ndarray:
+    def resolve_all(self, *, strict: bool = False) -> np.ndarray:
         """Resolve every interned id to a slot (allocating on miss);
         returns the id→slot array (i32[n_ids], -1 where the table is
-        full).  The host half of BucketTable.upload_id_rows."""
+        full).  The host half of BucketTable.upload_id_rows.
+
+        Partial coverage (a full table) is surfaced like assemble()'s
+        n_full: a warning by default, ValueError under strict=True; the
+        count of the last call is kept in `last_resolve_failures`.  The
+        -1 rows themselves are safe downstream — both by-id kernels mask
+        slot<0 lanes invalid — but callers deserve the signal."""
         n_ids = getattr(self, "_n_ids", 0)
         slots = np.empty(n_ids, np.int32)
-        self._lib.tk_resolve_all(
-            self._h, slots.ctypes.data_as(ctypes.c_void_p)
+        n_failed = int(
+            self._lib.tk_resolve_all(
+                self._h, slots.ctypes.data_as(ctypes.c_void_p)
+            )
         )
+        self.last_resolve_failures = n_failed
+        if n_failed:
+            msg = (
+                f"resolve_all: {n_failed}/{n_ids} interned ids could not "
+                "get a slot (table full); their id rows carry slot -1 "
+                "and will be decided as invalid"
+            )
+            if strict:
+                raise ValueError(msg)
+            import warnings
+
+            warnings.warn(msg, RuntimeWarning, stacklevel=2)
         return slots
 
     def assemble_ids(

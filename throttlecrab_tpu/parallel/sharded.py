@@ -40,10 +40,11 @@ from ..core.errors import InternalError
 from ..tpu.kernel import (
     EMPTY_EXPIRY,
     _gcra_body,
-    fits_cur_wire,
+    cur_wire_safe,
     pack_state,
     unpack_state,
 )
+from ..tpu.table import track_cur_safety
 from ..tpu.keymap import PyKeyMap
 from ..tpu.limiter import (
     BatchResult,
@@ -99,6 +100,9 @@ class ShardedBucketTable:
             self._host_empty(self.n_shards, rows), self.sharding
         )
         self._step_cache: dict = {}
+        # Cross-launch compact="cur" certificate, same contract as
+        # BucketTable.cur_safe (tpu/table.py track_cur_safety).
+        self.cur_safe = True
 
     @staticmethod
     def _host_empty(d: int, rows: int):
@@ -122,7 +126,7 @@ class ShardedBucketTable:
         cur = compact == "cur"
 
         def local(state, slots, rank, is_last, em, tol, q, valid, now):
-            st, out = _gcra_body(
+            st, out, n_exp = _gcra_body(
                 state[0],
                 (
                     slots[0],
@@ -136,14 +140,16 @@ class ShardedBucketTable:
                 ),
                 with_degen=with_degen,
                 compact=compact,
+                count_expired=True,
             )
             allowed_vec = (out & 1) if cur else (out[0] != 0)
             n_allowed = jnp.sum(allowed_vec.astype(jnp.int64))
             n_valid = jnp.sum(valid[0].astype(jnp.int64))
-            # The one collective on the hot path: global allowed/denied
-            # totals over ICI (BASELINE config 5's psum-reduced counters).
+            # The one collective on the hot path: global allowed/denied/
+            # expired-hit totals over ICI (BASELINE config 5's psum-reduced
+            # counters; expired hits feed the adaptive cleanup trigger).
             counters = lax.psum(
-                jnp.stack([n_allowed, n_valid - n_allowed]), AXIS
+                jnp.stack([n_allowed, n_valid - n_allowed, n_exp]), AXIS
             )
             return st[None], out[None], counters
 
@@ -180,6 +186,7 @@ class ShardedBucketTable:
         now_ns: int,
         with_degen: bool = True,
         compact: bool = False,
+        params_cur_safe: bool = False,
     ):
         """Decide stacked ``[D, B]`` per-shard batches in one launch.
 
@@ -188,6 +195,7 @@ class ShardedBucketTable:
         compact="cur" (host-finish with kernel.finish_cur).
         """
         assert slots.shape[1] <= self.SCRATCH
+        track_cur_safety(self, compact, params_cur_safe)
         step = self._step(with_degen, compact)
         self.state, out, counters = step(
             self.state,
@@ -222,16 +230,20 @@ class ShardedBucketTable:
         def local(state, slots, rank, is_last, em, tol, q, valid, now):
             def step(st, batch):
                 sl, rk, il, e, t, qq, v, nw = batch
-                st, out = _gcra_body(
+                st, out, n_exp = _gcra_body(
                     st,
                     (sl, rk.astype(jnp.int64), il, e, t, qq, v, nw),
                     with_degen=with_degen,
                     compact=compact,
+                    count_expired=True,
                 )
                 allowed_vec = (out & 1) if cur else (out[0] != 0)
                 n_allowed = jnp.sum(allowed_vec.astype(jnp.int64))
                 n_valid = jnp.sum(v.astype(jnp.int64))
-                return st, (out, jnp.stack([n_allowed, n_valid - n_allowed]))
+                return st, (
+                    out,
+                    jnp.stack([n_allowed, n_valid - n_allowed, n_exp]),
+                )
 
             st, (outs, counts) = lax.scan(
                 step,
@@ -277,6 +289,7 @@ class ShardedBucketTable:
         now_ns,
         with_degen: bool = True,
         compact: bool = False,
+        params_cur_safe: bool = False,
     ):
         """K stacked sub-batches per shard (``[D, K, B]`` inputs, i64[K]
         timestamps) in ONE launch.
@@ -286,6 +299,7 @@ class ShardedBucketTable:
         compact="cur" (host-finish with kernel.finish_cur).
         """
         assert slots.shape[2] <= self.SCRATCH
+        track_cur_safety(self, compact, params_cur_safe)
         step = self._scan_step(with_degen, compact)
         self.state, out, counters = step(
             self.state,
@@ -384,7 +398,7 @@ class _PendingShardedLaunch:
     def fetch(self) -> list:
         out = np.asarray(self._out_dev)
         c = np.asarray(self._counters)
-        self._limiter._bump_counters(int(c[0]), int(c[1]))
+        self._limiter._bump_counters(int(c[0]), int(c[1]), int(c[2]))
         if self._now_list is not None:
             from ..tpu.kernel import finish_cur
         results = []
@@ -469,17 +483,33 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
         # transport's decide thread, so accumulation takes its own lock.
         self.total_allowed = 0
         self.total_denied = 0
+        self.total_expired_hits = 0
         self._counter_lock = threading.Lock()
 
     def __len__(self) -> int:
         return sum(len(km) for km in self.keymaps)
 
-    def _bump_counters(self, allowed: int, denied: int) -> None:
+    def _bump_counters(
+        self, allowed: int, denied: int, expired: int = 0
+    ) -> None:
         """Accumulate the psum'd global counters; a launch fetch (engine
         executor thread) can race a native transport's decide thread."""
         with self._counter_lock:
             self.total_allowed += allowed
             self.total_denied += denied
+            self.total_expired_hits += expired
+
+    def take_expired_hits(
+        self, now_ns: int = 0, min_period_ns: int = 0
+    ) -> int:
+        """Drain the expired-hit counter for the cleanup policy.  Free:
+        the counts ride the already-fetched psum counters (no device
+        round trip), so both arguments exist only for signature parity
+        with TpuRateLimiter.take_expired_hits (no throttle needed)."""
+        with self._counter_lock:
+            n = self.total_expired_hits
+            self.total_expired_hits = 0
+            return n
 
     @property
     def total_capacity(self) -> int:
@@ -602,13 +632,14 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
         )
         D = self.n_shards
         B = slots.shape[1]
-        with_degen = not wire or has_degenerate(
-            valid, emission, tolerance, quantity
-        )
+        degen = has_degenerate(valid, emission, tolerance, quantity)
+        with_degen = not wire or degen
         # 8 B/request "cur" output when the certified fast path and the
-        # fits_cur_wire bound hold (host-finished, same wire values).
+        # valid-masked cur bound hold (host-finished, same wire values);
+        # table.cur_safe carries the certificate across launches.
+        params_cur_safe = cur_wire_safe(valid, tolerance, now_ns)
         use_cur = (
-            wire and not with_degen and fits_cur_wire(tolerance, now_ns)
+            wire and not degen and params_cur_safe and self.table.cur_safe
         )
         if use_cur:
             from ..tpu.kernel import finish_cur
@@ -634,10 +665,11 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
                 slots, rk, il, em, tol, q, rmask, now_ns,
                 with_degen=with_degen,
                 compact="cur" if use_cur else wire,
+                params_cur_safe=params_cur_safe,
             )
             out = np.asarray(out_dev)
             c = np.asarray(counters)
-            self._bump_counters(int(c[0]), int(c[1]))
+            self._bump_counters(int(c[0]), int(c[1]), int(c[2]))
             for d, ix in enumerate(per_shard):
                 m = len(ix)
                 if m == 0:
@@ -741,17 +773,23 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
             now_s[j] = batches[j][5]
 
         # 8 B/request "cur" output off the mesh when the certified fast
-        # path and the fits_cur_wire bound hold (same rule as the
+        # path and the valid-masked cur bound hold (same rule as the
         # single-device dispatch paths); host-finished in fetch().
+        # table.cur_safe carries the certificate across launches.
+        params_cur_safe = cur_wire_safe(
+            valid_s, tol_s, int(now_s.max(initial=0))
+        )
         use_cur = (
             wire
             and not any_degen
-            and fits_cur_wire(tol_s, int(now_s.max(initial=0)))
+            and params_cur_safe
+            and self.table.cur_safe
         )
         out_dev, counters = self.table.check_many(
             slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s,
             with_degen=not wire or any_degen,
             compact="cur" if use_cur else wire,
+            params_cur_safe=params_cur_safe,
         )
         return _PendingShardedLaunch(
             self, out_dev, counters, prepared, wire,

@@ -395,22 +395,55 @@ class BatchingEngine:
         # The policy instance may be shared with a native transport's
         # driver thread (server/native_redis.py): all policy state moves
         # under limiter_lock.
+        from ..tpu.cleanup import feed_expired_hits
+
         with self.limiter_lock:
             policy.record_ops(n_ops)
+            # Adaptive policies consume the kernel's expired-hit count.
+            # The single-device drain is a blocking device→host scalar
+            # fetch that synchronizes on every in-flight launch — never
+            # run it on the event-loop thread; when the limiter says a
+            # fetch is due (throttled to ~1/s) the drain moves to the
+            # executor below.  Sharded drains are host-side counters
+            # (free) and stay inline.
+            fetch_due = getattr(policy, "uses_expired_signal", False) and (
+                getattr(self.limiter, "expired_hits_fetch_due", None)
+                is not None
+                and self.limiter.expired_hits_fetch_due(now_ns)
+            )
+            if not fetch_due:
+                feed_expired_hits(policy, self.limiter, now_ns)
             live = len(self.limiter)
             capacity = getattr(self.limiter, "total_capacity", 1 << 62)
-            should = policy.should_clean(now_ns, live, capacity)
+            should = fetch_due or policy.should_clean(now_ns, live, capacity)
         if should:
             loop = asyncio.get_running_loop()
 
-            def locked_sweep():
+            def locked_policy_step():
                 with self.limiter_lock:
+                    live_now = live
+                    if fetch_due:
+                        feed_expired_hits(policy, self.limiter, now_ns)
+                        live_now = len(self.limiter)
+                        if not policy.should_clean(
+                            now_ns, live_now, capacity
+                        ):
+                            return None
+                    # Attribute hits already counted on-device to the
+                    # window this sweep closes (after_sweep resets the
+                    # policy's count — a late drain would leak them into
+                    # the fresh window).  Redundant when fetch_due: the
+                    # drain above just ran under this same lock hold.
+                    if not fetch_due:
+                        feed_expired_hits(
+                            policy, self.limiter, now_ns, force=True
+                        )
                     freed = self.limiter.sweep(now_ns)
-                    policy.after_sweep(now_ns, freed, live)
+                    policy.after_sweep(now_ns, freed, live_now)
                     return freed
 
-            freed = await loop.run_in_executor(None, locked_sweep)
-            if self.metrics is not None:
+            freed = await loop.run_in_executor(None, locked_policy_step)
+            if freed is not None and self.metrics is not None:
                 self.metrics.record_sweep(freed)
 
     async def shutdown(self) -> None:

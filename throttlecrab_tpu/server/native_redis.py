@@ -354,12 +354,19 @@ class NativeRedisTransport:
         policy = self.cleanup_policy
         if policy is None:
             return
+        from ..tpu.cleanup import feed_expired_hits
+
         with self.limiter_lock:
             policy.record_ops(n_ops)
+            feed_expired_hits(policy, self.limiter, now_ns)
             live = len(self.limiter)
             capacity = getattr(self.limiter, "total_capacity", 1 << 62)
             if not policy.should_clean(now_ns, live, capacity):
                 return
+            # Attribute on-device hits to the window this sweep closes
+            # (see engine._maybe_sweep); this driver thread already
+            # sweeps inline, so the blocking fetch is acceptable here.
+            feed_expired_hits(policy, self.limiter, now_ns, force=True)
             freed = self.limiter.sweep(now_ns)
             policy.after_sweep(now_ns, freed, live)
         if self.metrics is not None:

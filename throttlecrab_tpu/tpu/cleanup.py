@@ -5,12 +5,12 @@ The reference couples cleanup policy to its three store types
 `probabilistic.rs:110-125`); here the sweep itself is one jitted mask over
 the expiry column (kernel.sweep_expired) and the policy is a host object the
 engine consults between batches.  The trigger/adaptation rules are preserved
-verbatim, with one noted deviation: the adaptive expired-ratio trigger
-tracked per-op expired hits inside the Rust store; on the TPU path the
-equivalent signal (how many requests landed on expired entries) lives on the
-device, so the adaptive policy instead uses its time, operation-count and
-capacity-pressure triggers, plus the same interval doubling/halving from
-sweep yield.
+verbatim, including the adaptive expired-ratio trigger: the per-op expired
+hits the Rust store counted inline (`adaptive_cleanup.rs:233,267`) are
+counted by the kernel itself (a device-resident accumulator riding every
+launch, kernel.gcra_*_acc) and drained to the policy via
+`record_expired` — fetched at most once per second, the policy's own
+minimum interval, since its triggers have no sub-second semantics.
 
 Policies are consulted with *batches* of operations (the engine processes
 thousands of requests per step), so the probabilistic fire-check covers the
@@ -30,6 +30,10 @@ MIN_CLEANUP_INTERVAL_SECS = 1
 MAX_CLEANUP_INTERVAL_SECS = 300
 ADAPTIVE_DEFAULT_INTERVAL_SECS = 5
 MAX_OPERATIONS_BEFORE_CLEANUP = 100_000
+EXPIRED_RATIO_THRESHOLD = 0.2  # adaptive_cleanup.rs:16
+# Ratio trigger floor — EXCLUSIVE bound, `expired_count > 50` verbatim
+# (adaptive_cleanup.rs:150): exactly 50 hits never triggers.
+MIN_EXPIRED_FOR_RATIO = 50
 PROBABILISTIC_CLEANUP_MODULO = 1000
 _PRIME = 2654435761
 
@@ -37,8 +41,15 @@ _PRIME = 2654435761
 class CleanupPolicy:
     """Decides when the engine should sweep; see subclasses."""
 
+    #: True when the policy consumes the expired-hit signal — the engine
+    #: only pays the (throttled) device read for policies that want it.
+    uses_expired_signal = False
+
     def record_ops(self, n: int) -> None:
         """Account `n` processed requests."""
+
+    def record_expired(self, n: int) -> None:
+        """Account `n` requests that landed on expired entries."""
 
     def should_clean(self, now_ns: int, live_keys: int, capacity: int) -> bool:
         raise NotImplementedError
@@ -103,11 +114,18 @@ class ProbabilisticPolicy(CleanupPolicy):
 class AdaptivePolicy(CleanupPolicy):
     """Self-tuning sweeps (adaptive_cleanup.rs:138-203).
 
-    Triggers: time >= next_cleanup, ops since last sweep >= max_operations,
-    or live keys above 3/4 of table capacity.  After each sweep the interval
-    doubles (nothing removed) or halves (over half removed), clamped to
+    Triggers, in the reference's order: time >= next_cleanup; ops since
+    last sweep >= max_operations; expired-hit ratio above a dynamic
+    threshold (STRICTLY more than 50 hits — `expired_count > 50`,
+    adaptive_cleanup.rs:150 — and hits/keys over 10 % after a
+    productive sweep, i.e. the last sweep removed over a quarter of the
+    table, else 25 %); or keys above 3/4 of table capacity.
+    After each sweep the interval doubles (nothing removed and no
+    expired hits seen) or halves (over half removed), clamped to
     [min_interval, max_interval].
     """
+
+    uses_expired_signal = True
 
     def __init__(
         self,
@@ -121,9 +139,15 @@ class AdaptivePolicy(CleanupPolicy):
         self.current_interval_ns = ADAPTIVE_DEFAULT_INTERVAL_SECS * NS_PER_SEC
         self._next_ns: Optional[int] = None
         self._ops = 0
+        self._expired = 0
+        self._last_removed = 0
+        self._last_total = 0
 
     def record_ops(self, n):
         self._ops += n
+
+    def record_expired(self, n):
+        self._expired += n
 
     def should_clean(self, now_ns, live_keys, capacity):
         if self._next_ns is None:
@@ -132,12 +156,25 @@ class AdaptivePolicy(CleanupPolicy):
             return True
         if self._ops >= self.max_operations:
             return True
+        # Expired-ratio trigger with the dynamic threshold: clean at
+        # half threshold when the last sweep was productive, else wait
+        # until 125 % of it (adaptive_cleanup.rs:150-163).
+        if self._expired > MIN_EXPIRED_FOR_RATIO:
+            ratio = self._expired / max(live_keys, 1)
+            if self._last_removed > self._last_total // 4:
+                threshold = EXPIRED_RATIO_THRESHOLD / 2.0
+            else:
+                threshold = EXPIRED_RATIO_THRESHOLD * 1.25
+            if ratio > threshold:
+                return True
         if live_keys > capacity * 3 // 4:
             return True
         return False
 
     def after_sweep(self, now_ns, removed, total_before):
-        if removed == 0:
+        # adaptive_cleanup.rs:187-195: the interval only relaxes when the
+        # sweep found nothing AND no traffic hit an expired entry.
+        if removed == 0 and self._expired == 0:
             self.current_interval_ns = min(
                 self.current_interval_ns * 2, self.max_interval_ns
             )
@@ -145,8 +182,31 @@ class AdaptivePolicy(CleanupPolicy):
             self.current_interval_ns = max(
                 self.current_interval_ns // 2, self.min_interval_ns
             )
+        self._last_removed = removed
+        self._last_total = total_before
         self._next_ns = now_ns + self.current_interval_ns
         self._ops = 0
+        self._expired = 0
+
+
+def feed_expired_hits(policy, limiter, now_ns: int, force: bool = False) -> None:
+    """Drain the limiter's expired-hit counter into a policy that wants
+    it.  Shared by every transport's sweep hook (engine._maybe_sweep and
+    the native driver's); call under limiter_lock.
+
+    `force=True` bypasses the fetch throttle — used just before a sweep
+    so hits counted on-device are attributed to the pre-sweep window
+    (after_sweep resets the policy's count; draining late would leak
+    them into the fresh window and could fire a redundant ratio sweep).
+    """
+    if not getattr(policy, "uses_expired_signal", False):
+        return
+    take = getattr(limiter, "take_expired_hits", None)
+    if take is None:
+        return
+    n = take(now_ns, 0) if force else take(now_ns)
+    if n:
+        policy.record_expired(n)
 
 
 def make_policy(name: str, **kwargs) -> CleanupPolicy:

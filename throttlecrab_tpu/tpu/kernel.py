@@ -171,6 +171,29 @@ def fits_cur_wire(tolerance, now_ns) -> bool:
     )
 
 
+def cur_wire_safe(valid, tolerance, now_ns) -> bool:
+    """Valid-lane-masked fits_cur_wire, for batches that carry rejected
+    or padding lanes.
+
+    The cur certificate only concerns lanes that are actually decided
+    and written: a rejected request's wrapped-garbage tolerance (e.g.
+    burst 0 → u32-wrapped tol ~4.3e18) must neither forfeit the current
+    launch's cur output (invalid lanes are don't-care in the wire) nor
+    poison the table's cross-launch `cur_safe` flag.  The same bound
+    serves both purposes because every allowed write is <= now + tol of
+    its own lane (saturating paths included), so `now < 2^61` plus
+    `tol < 2^61` on every VALID lane keeps all stored TATs < 2^62 —
+    degenerate lanes (quantity-0 probes, zero emission, big-inc) obey
+    the same write bound and need no special case.  tk_prepare_batch's
+    PREP_BIGTOL is the C++ twin (it skips invalid lanes the same way).
+    """
+    import numpy as np
+
+    return bool(now_ns < (1 << 61)) and not bool(
+        np.any(np.asarray(valid) & (np.asarray(tolerance) >= (1 << 61)))
+    )
+
+
 def finish_cur(cur2, emission, tolerance, quantity, now_ns):
     """Host-side completion of the compact="cur" device output (numpy).
 
@@ -257,8 +280,10 @@ def _request_outputs(t, inc, emission, tol, now):
     return allowed, remaining, reset_after, retry_after, new_tat, ttl
 
 
-def _gcra_body(state, batch, *, with_degen=True, compact=False):
-    """Decide one micro-batch; returns (state, out).
+def _gcra_body(state, batch, *, with_degen=True, compact=False,
+               count_expired=False):
+    """Decide one micro-batch; returns (state, out), plus the batch's
+    expired-hit count when count_expired=True.
 
     `state` is the packed i32[N, 4] table (see pack_state).
 
@@ -364,9 +389,27 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False):
         jnp.maximum(s_sub(s_sub(s_add(cur_main, inc), tol), now), 0),
     )
 
+    # The reference's adaptive store counts requests that land on an
+    # entry past its expiry — but only via the WRITE path: an expired
+    # entry makes get() return None, and only an ALLOWED request then
+    # reaches set_if_not_exists, which sees the stale entry, counts the
+    # hit, and refreshes it (adaptive_cleanup.rs:267; denied requests
+    # never touch the store again, and later ranks of the segment see
+    # the refreshed entry).  So the signal is: rank-0 valid lane, real
+    # stored expiry (not the EMPTY_EXPIRY sentinel) <= now, and that
+    # lane allowed.  (One knowing deviation: a ttl-0 "dead" write's
+    # allowed re-hits within the same batch are not re-counted.)
+    if count_expired:
+        exp_hit_base = (
+            v
+            & (rank == 0)
+            & (stored_exp != EMPTY_EXPIRY)
+            & (stored_exp <= now)
+        )
+
     # ---- degenerate case: three-view closed form ---------------------------
     if not with_degen:
-        return _finish(
+        st_out = _finish(
             state, s, N, now, tol,
             allowed_main & v,
             remaining_main,
@@ -378,6 +421,12 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False):
             s_add, s_sub,
             cur=cur_main,
         )
+        if count_expired:
+            n_exp = jnp.sum(
+                (exp_hit_base & allowed_main).astype(jnp.int64)
+            )
+            return (*st_out, n_exp)
+        return st_out
 
     degen = (inc == 0) | (tol == 0)
 
@@ -440,12 +489,17 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False):
 
     wrote = jnp.where(degen, wrote_degen, m_raw >= 1) & v & is_last
     tat_fin = jnp.where(degen, tat_fin_degen, tat_fin_main)
-    return _finish(
+    st_out = _finish(
         state, s, N, now, tol,
         allowed_out, remaining_out, reset_out, retry_out,
         wrote, tat_fin, compact,
         sat_add, sat_sub,
     )
+    if count_expired:
+        # allowed_out already carries & v.
+        n_exp = jnp.sum((exp_hit_base & allowed_out).astype(jnp.int64))
+        return (*st_out, n_exp)
+    return st_out
 
 
 _I32_MAX = (1 << 31) - 1
@@ -704,25 +758,37 @@ def gcra_scan_byid(
     whose valid bit is 0 are padding.  Returns (state, out) with `out`
     per the `compact` mode.
     """
-    n_ids = id_rows.shape[0]
-
     def step(state, kb):
         w, now_k = kb
-        idx = jnp.clip((w & _U32).astype(jnp.int32), 0, n_ids - 1)
-        meta = w >> 32
-        batch = _rows_to_batch(
-            id_rows[idx],
-            meta & 0x3FFF,                                # rank (i64)
-            (meta & (1 << 14)) != 0,                      # is_last
-            (meta & (1 << 15)) != 0,                      # valid
-            quantity,
-            now_k,
-        )
         return _gcra_body(
-            state, batch, with_degen=with_degen, compact=compact
+            state,
+            _byid_batch(w, now_k, id_rows, quantity),
+            with_degen=with_degen,
+            compact=compact,
         )
 
     return jax.lax.scan(step, state, (words, now.astype(jnp.int64)))
+
+
+def _byid_batch(w, now_k, id_rows, quantity):
+    """One sub-batch of 8-byte request words → the _gcra_body tuple
+    (shared by gcra_scan_byid and its expired-counting twin)."""
+    n_ids = id_rows.shape[0]
+    idx = jnp.clip((w & _U32).astype(jnp.int32), 0, n_ids - 1)
+    meta = w >> 32
+    rows = id_rows[idx]
+    # Same -1-slot defense as gcra_scan_ids: an unresolved id row
+    # (resolve_all on a full table) carries slot -1, which would
+    # otherwise clip to slot 0 and corrupt another key's bucket.
+    valid = ((meta & (1 << 15)) != 0) & (rows[:, 0] >= 0)
+    return _rows_to_batch(
+        rows,
+        meta & 0x3FFF,                                # rank (i64)
+        (meta & (1 << 14)) != 0,                      # is_last
+        valid,
+        quantity,
+        now_k,
+    )
 
 
 def _device_segments(segkey):
@@ -776,33 +842,188 @@ def gcra_scan_ids(
     split — a real segment.  Semantically identical to gcra_scan_byid
     on tk_assemble_ids words (pinned by tests/test_packed_path.py).
     """
-    n_ids = id_rows.shape[0]
 
     def step(state, kb):
         w, now_k = kb
-        # In-range check mirrors the host assembler's n_bad contract: an
-        # id beyond the resident rows (interned after upload, or
-        # corrupt) must be invalid, never clipped onto another key.
-        valid = (w >= 0) & (w < n_ids)
-        idx = jnp.clip(w, 0, n_ids - 1)
-        rows = id_rows[idx]
-        slots = rows[:, 0]
-        # An unresolved id row carries slot -1 (resolve_all on a full
-        # table); never decide those against clipped slot 0.
-        valid = valid & (slots >= 0)
-        B = w.shape[0]
-        pos = jnp.arange(B, dtype=jnp.int32)
-        # Segment key: the slot for real lanes; a distinct out-of-range
-        # sentinel per invalid lane (slots are clipped to [0, N) by the
-        # kernel, so I32_MAX - pos can collide with nothing real).
-        segkey = jnp.where(valid, slots, _I32_MAX - pos)
-        rank, is_last = _device_segments(segkey)
-        batch = _rows_to_batch(rows, rank, is_last, valid, quantity, now_k)
         return _gcra_body(
-            state, batch, with_degen=with_degen, compact=compact
+            state,
+            _ids_batch(w, now_k, id_rows, quantity),
+            with_degen=with_degen,
+            compact=compact,
         )
 
     return jax.lax.scan(step, state, (ids, now.astype(jnp.int64)))
+
+
+def _ids_batch(w, now_k, id_rows, quantity):
+    """One sub-batch of raw key ids → the _gcra_body tuple (shared by
+    gcra_scan_ids and its expired-counting twin)."""
+    n_ids = id_rows.shape[0]
+    # In-range check mirrors the host assembler's n_bad contract: an
+    # id beyond the resident rows (interned after upload, or
+    # corrupt) must be invalid, never clipped onto another key.
+    valid = (w >= 0) & (w < n_ids)
+    idx = jnp.clip(w, 0, n_ids - 1)
+    rows = id_rows[idx]
+    slots = rows[:, 0]
+    # An unresolved id row carries slot -1 (resolve_all on a full
+    # table); never decide those against clipped slot 0.
+    valid = valid & (slots >= 0)
+    B = w.shape[0]
+    pos = jnp.arange(B, dtype=jnp.int32)
+    # Segment key: the slot for real lanes; a distinct out-of-range
+    # sentinel per invalid lane (slots are clipped to [0, N) by the
+    # kernel, so I32_MAX - pos can collide with nothing real).
+    segkey = jnp.where(valid, slots, _I32_MAX - pos)
+    rank, is_last = _device_segments(segkey)
+    return _rows_to_batch(rows, rank, is_last, valid, quantity, now_k)
+
+
+# ---- expired-hit accounting twins -------------------------------------- #
+# Same decisions (bit-for-bit) as their namesakes plus a device-resident
+# accumulator: a donated i64 scalar that grows by each sub-batch's
+# expired-hit count (see _gcra_body count_expired — the signal behind the
+# reference adaptive store's expired-ratio cleanup trigger,
+# adaptive_cleanup.rs:150-163).  BucketTable routes every launch through
+# these; the plain entry points above remain the public single-concern
+# kernel API (tests, probes, examples, and external callers that bring
+# their own state arrays).  Both halves share _gcra_body and the
+# _byid_batch/_ids_batch builders, so they cannot drift.  The count
+# rides the launch — no extra dispatch, no extra fetch; the host reads
+# the scalar only when the cleanup policy wants it
+# (BucketTable.expired_hits).
+
+
+@partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("with_degen", "compact")
+)
+def gcra_batch_acc(
+    state, exp_acc, slots, rank, is_last, emission, tolerance, quantity,
+    valid, now, *, with_degen=True, compact=False,
+):
+    """gcra_batch + expired-hit accumulation; returns (state, acc, out)."""
+    state, out, n_exp = _gcra_body(
+        state,
+        (
+            slots,
+            rank.astype(jnp.int64),
+            is_last,
+            emission,
+            tolerance,
+            quantity,
+            valid,
+            jnp.asarray(now, jnp.int64),
+        ),
+        with_degen=with_degen,
+        compact=compact,
+        count_expired=True,
+    )
+    return state, exp_acc + n_exp, out
+
+
+@partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("with_degen", "compact")
+)
+def gcra_scan_acc(
+    state, exp_acc, slots, rank, is_last, emission, tolerance, quantity,
+    valid, now, *, with_degen=True, compact=False,
+):
+    """gcra_scan + expired-hit accumulation; returns (state, acc, out)."""
+
+    def step(carry, batch):
+        st, acc = carry
+        st, out, n = _gcra_body(
+            st, batch, with_degen=with_degen, compact=compact,
+            count_expired=True,
+        )
+        return (st, acc + n), out
+
+    (state, exp_acc), outs = jax.lax.scan(
+        step,
+        (state, exp_acc),
+        (
+            slots,
+            rank.astype(jnp.int64),
+            is_last,
+            emission,
+            tolerance,
+            quantity,
+            valid,
+            now.astype(jnp.int64),
+        ),
+    )
+    return state, exp_acc, outs
+
+
+@partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("with_degen", "compact")
+)
+def gcra_scan_packed_acc(
+    state, exp_acc, packed, now, *, with_degen=True, compact=False,
+):
+    """gcra_scan_packed + expired-hit accumulation."""
+
+    def step(carry, kb):
+        st, acc = carry
+        p, now_k = kb
+        st, out, n = _gcra_body(
+            st, _unpack_requests(p, now_k),
+            with_degen=with_degen, compact=compact, count_expired=True,
+        )
+        return (st, acc + n), out
+
+    (state, exp_acc), outs = jax.lax.scan(
+        step, (state, exp_acc), (packed, now.astype(jnp.int64))
+    )
+    return state, exp_acc, outs
+
+
+@partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("with_degen", "compact")
+)
+def gcra_scan_byid_acc(
+    state, exp_acc, id_rows, words, now, quantity, *,
+    with_degen=True, compact=False,
+):
+    """gcra_scan_byid + expired-hit accumulation."""
+
+    def step(carry, kb):
+        st, acc = carry
+        w, now_k = kb
+        st, out, n = _gcra_body(
+            st, _byid_batch(w, now_k, id_rows, quantity),
+            with_degen=with_degen, compact=compact, count_expired=True,
+        )
+        return (st, acc + n), out
+
+    (state, exp_acc), outs = jax.lax.scan(
+        step, (state, exp_acc), (words, now.astype(jnp.int64))
+    )
+    return state, exp_acc, outs
+
+
+@partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("with_degen", "compact")
+)
+def gcra_scan_ids_acc(
+    state, exp_acc, id_rows, ids, now, quantity, *,
+    with_degen=True, compact=False,
+):
+    """gcra_scan_ids + expired-hit accumulation."""
+
+    def step(carry, kb):
+        st, acc = carry
+        w, now_k = kb
+        st, out, n = _gcra_body(
+            st, _ids_batch(w, now_k, id_rows, quantity),
+            with_degen=with_degen, compact=compact, count_expired=True,
+        )
+        return (st, acc + n), out
+
+    (state, exp_acc), outs = jax.lax.scan(
+        step, (state, exp_acc), (ids, now.astype(jnp.int64))
+    )
+    return state, exp_acc, outs
 
 
 @partial(jax.jit, donate_argnums=(1,), static_argnames=("capacity",))

@@ -431,8 +431,41 @@ class TpuRateLimiter(ScalarCompatMixin):
         else:
             self.keymap = keymap
         self.auto_grow = auto_grow
+        self._exp_hits_read = 0
+        self._exp_hits_last_fetch_ns: Optional[int] = None
 
     # ------------------------------------------------------------------ #
+
+    def expired_hits_fetch_due(
+        self, now_ns: int, min_period_ns: int = 1_000_000_000
+    ) -> bool:
+        """True when take_expired_hits would actually hit the device —
+        lets callers on latency-sensitive threads (the asyncio engine)
+        route the blocking scalar fetch to an executor instead."""
+        last = self._exp_hits_last_fetch_ns
+        return last is None or now_ns - last >= min_period_ns
+
+    def take_expired_hits(
+        self, now_ns: int, min_period_ns: int = 1_000_000_000
+    ) -> int:
+        """New expired-hit count since the last call, for the adaptive
+        cleanup policy's expired-ratio trigger.
+
+        The count lives in a device-resident accumulator that rides
+        every decision launch for free (kernel gcra_*_acc); reading it
+        is one scalar device→host fetch, so the read is throttled to
+        once per `min_period_ns` (default 1 s — the policy's own minimum
+        cleanup interval; all its triggers operate at >= 1 s
+        granularity, so a staler signal is indistinguishable).  Returns
+        0 between fetches."""
+        last = self._exp_hits_last_fetch_ns
+        if last is not None and now_ns - last < min_period_ns:
+            return 0
+        self._exp_hits_last_fetch_ns = now_ns
+        total = self.table.expired_hits()
+        delta = total - self._exp_hits_read
+        self._exp_hits_read = total
+        return delta
 
     def rate_limit_batch(
         self,
@@ -459,9 +492,11 @@ class TpuRateLimiter(ScalarCompatMixin):
          slots, rank0, is_last0, rounds) = self._prepare_one(
             keys, max_burst, count_per_period, period, quantity, now_ns
         )
-        with_degen = not wire or has_degenerate(
-            valid, emission, tolerance, quantity
-        )
+        degen = has_degenerate(valid, emission, tolerance, quantity)
+        with_degen = not wire or degen
+        from .kernel import cur_wire_safe
+
+        params_cur_safe = cur_wire_safe(valid, tolerance, now_ns)
 
         pad = max(self.MIN_PAD, 1 << (n - 1).bit_length())
         slots_p = np.zeros(pad, np.int32)
@@ -496,6 +531,7 @@ class TpuRateLimiter(ScalarCompatMixin):
             out_dev = self.table.check_batch(
                 slots_p, rank, is_last, em_p, tol_p, q_p, valid_p, now_ns,
                 with_degen=with_degen, compact=wire,
+                params_cur_safe=params_cur_safe,
             )
             # One device→host fetch per round; rounds beyond 0 are rare.
             out = np.asarray(out_dev)[:, :n]
@@ -660,24 +696,31 @@ class TpuRateLimiter(ScalarCompatMixin):
         # tunnel charges ~6 ms per transfer *call*, so eight per-array
         # transfers per launch would cost more than the device work
         # (docs/tpu-launch-profile.md).
-        from .kernel import fits_cur_wire, pack_requests
+        from .kernel import cur_wire_safe, pack_requests
 
         packed = pack_requests(
             slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s
         )
         # The 8 B/request "cur" output halves the fetch whenever the
-        # certified fast path applies and the fits_cur_wire bound holds
-        # (now/tol < 2^61); finished to identical wire values on the
-        # host in _PendingLaunch.fetch.
+        # certified fast path applies and the valid-masked cur bound
+        # holds (now/tol < 2^61); finished to identical wire values on
+        # the host in _PendingLaunch.fetch.  table.cur_safe extends the
+        # certificate across launches: a prior big-tolerance launch can
+        # persist a TAT >= 2^62 whose cur word would wrap (ADVICE r4).
+        params_cur_safe = cur_wire_safe(
+            valid_s, tol_s, int(now_s.max(initial=0))
+        )
         use_cur = (
             wire
             and not any_degen
-            and fits_cur_wire(tol_s, int(now_s.max(initial=0)))
+            and params_cur_safe
+            and self.table.cur_safe
         )
         out_dev = self.table.check_many_packed(
             packed, now_s,
             with_degen=not wire or any_degen,
             compact="cur" if use_cur else wire,
+            params_cur_safe=params_cur_safe,
         )
         return _PendingLaunch(out_dev, prepared, valid_s, wire, cur=use_cur)
 
@@ -727,10 +770,16 @@ class TpuRateLimiter(ScalarCompatMixin):
         # the certified fast path and the fits_cur_wire bound both hold;
         # else the 4-plane compact i32 output.  Same exact wire values
         # either way (tests/test_wire_path.py pins the equivalence).
+        # table.cur_safe carries the certificate across launches (a
+        # prior big-tol launch can store a TAT >= 2^62 — ADVICE r4).
+        # PREP_BIGTOL is set only for VALID lanes (invalid params skip
+        # derivation in tk_prepare_batch), and degenerate lanes obey the
+        # same write bound, so bigtol + now alone decide state safety.
+        params_cur_safe = not any_bigtol and now_ns < (1 << 61)
         use_cur = (
             not any_degen
-            and not any_bigtol
-            and now_ns < (1 << 61)
+            and params_cur_safe
+            and self.table.cur_safe
             and hasattr(km, "finish")
         )
         K = len(prepared)
@@ -743,6 +792,7 @@ class TpuRateLimiter(ScalarCompatMixin):
             np.full(K_pad, now_ns, np.int64),
             with_degen=any_degen,
             compact="cur" if use_cur else True,
+            params_cur_safe=params_cur_safe,
         )
         if use_cur:
             return _PendingWireLaunch(

@@ -248,6 +248,13 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
     import jax.numpy as jnp
 
     from .kernel import pack_state
+    from .table import tats_cur_safe
+
+    # Restored TATs are foreign state: the table's cross-launch
+    # compact="cur" certificate (table.cur_safe) only survives if every
+    # restored value sits in the proven-safe range (see track_cur_safety).
+    if not tats_cur_safe(tats):
+        limiter.table.cur_safe = False
 
     if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
         import jax

@@ -22,16 +22,55 @@ import numpy as np
 
 from .kernel import (
     EMPTY_EXPIRY,
-    gcra_batch,
-    gcra_scan,
-    gcra_scan_byid,
-    gcra_scan_ids,
-    gcra_scan_packed,
+    gcra_batch_acc,
+    gcra_scan_acc,
+    gcra_scan_byid_acc,
+    gcra_scan_ids_acc,
+    gcra_scan_packed_acc,
     pack_id_rows,
     pack_state,
     sweep_expired,
     unpack_state,
 )
+
+
+# Stored-TAT bound for the compact="cur" output: the device emits
+# `cur * 2 + allowed` in i64, and a denied lane's cur can be the STORED
+# TAT verbatim (kernel t0 = max(stored_tat, now - tol) with m_raw = 0),
+# so every live TAT must sit in [0, 2^62) for the shift to be exact.
+# Launches whose params satisfy the per-launch certificate (no
+# degenerate request, tol/now < 2^61) only ever write TATs in
+# [0, now + tol] ⊂ [0, 2^62); any other launch may store values
+# anywhere in i64 (the 4-plane paths handle those exactly — the cur
+# shift alone would wrap).
+CUR_TAT_BOUND = 1 << 62
+
+
+def track_cur_safety(table, compact, params_cur_safe) -> None:
+    """Cross-launch half of the compact="cur" certificate.
+
+    fits_cur_wire (kernel.py) bounds only the CURRENT launch; a prior
+    big-tolerance launch can persist a TAT >= 2^62 for a key, and a
+    later normal-tolerance cur-mode launch on that key would wrap
+    `cur * 2 + allowed`.  So the table tracks a sticky `cur_safe` flag:
+    a launch preserves it iff its own params are certified — either
+    implicitly (compact="cur" callers certify by contract) or via
+    `params_cur_safe=True`.  Dispatchers consult `table.cur_safe`
+    before choosing the cur wire mode.
+    """
+    if compact != "cur" and not params_cur_safe:
+        table.cur_safe = False
+
+
+def tats_cur_safe(tats) -> bool:
+    """Host-side audit of raw i64 TAT values: True iff every one is in
+    [0, CUR_TAT_BOUND) — the condition under which compact="cur"
+    launches are exact against state holding them.  Snapshot restore
+    uses this to re-derive `cur_safe` for foreign state."""
+    tat = np.asarray(tats, np.int64)
+    return tat.size == 0 or bool(
+        ((tat >= 0) & (tat < CUR_TAT_BOUND)).all()
+    )
 
 
 class StaleIdRowsError(RuntimeError):
@@ -74,6 +113,27 @@ class BucketTable:
         self.capacity = capacity
         self.device = device
         self.state = self._alloc(capacity + self.SCRATCH)
+        # True while every stored TAT provably sits in [0, 2^62) — the
+        # cross-launch precondition of the compact="cur" wire mode (see
+        # track_cur_safety).  Fresh state is all-zero TATs: safe.
+        self.cur_safe = True
+        # Device-resident expired-hit accumulator: donated through every
+        # decision launch (kernel gcra_*_acc), read only on demand — the
+        # signal behind the adaptive cleanup policy's expired-ratio
+        # trigger (adaptive_cleanup.rs:150-163).
+        ctx = (
+            jax.default_device(self.device)
+            if self.device is not None
+            else _nullcontext()
+        )
+        with ctx:
+            self.exp_acc = jnp.zeros((), jnp.int64)
+
+    def expired_hits(self) -> int:
+        """Total expired-hit count since construction.  One scalar
+        device→host fetch — callers throttle (see
+        TpuRateLimiter.take_expired_hits)."""
+        return int(self.exp_acc)
 
     def _alloc(self, rows: int) -> jax.Array:
         ctx = (
@@ -109,15 +169,22 @@ class BucketTable:
         now_ns: int,
         with_degen: bool = True,
         compact: bool = False,
+        params_cur_safe: bool = False,
     ) -> jax.Array:
         """Run one decision batch; updates the table state in place.
 
         Returns the stacked device output [4, B]: rows are (allowed,
         remaining, reset_after, retry_after) — fetch with one np.asarray.
+
+        `params_cur_safe=True` asserts this launch's params satisfy the
+        cur certificate (no degenerate request, tol/now < 2^61) so the
+        table's `cur_safe` flag survives; compact="cur" implies it.
         """
         assert len(slots) <= self.SCRATCH, "batch exceeds scratch region"
-        self.state, out = gcra_batch(
+        track_cur_safety(self, compact, params_cur_safe)
+        self.state, self.exp_acc, out = gcra_batch_acc(
             self.state,
+            self.exp_acc,
             jnp.asarray(slots, jnp.int32),
             jnp.asarray(rank, jnp.int32),
             jnp.asarray(is_last, bool),
@@ -143,12 +210,15 @@ class BucketTable:
         now_ns: np.ndarray,
         with_degen: bool = True,
         compact: bool = False,
+        params_cur_safe: bool = False,
     ) -> jax.Array:
         """K stacked micro-batches ([K, B] inputs, i64[K] timestamps) in one
         launch; returns the [K, 4, B] stacked device output."""
         assert slots.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
-        self.state, out = gcra_scan(
+        track_cur_safety(self, compact, params_cur_safe)
+        self.state, self.exp_acc, out = gcra_scan_acc(
             self.state,
+            self.exp_acc,
             jnp.asarray(slots, jnp.int32),
             jnp.asarray(rank, jnp.int32),
             jnp.asarray(is_last, bool),
@@ -168,6 +238,7 @@ class BucketTable:
         now_ns,
         with_degen: bool = True,
         compact=False,
+        params_cur_safe: bool = False,
     ) -> jax.Array:
         """K stacked micro-batches from ONE packed i32[K, B, PACK_WIDTH]
         buffer (see kernel.pack_requests); `now_ns` is i64[K].
@@ -185,8 +256,10 @@ class BucketTable:
         or an already-transferred device array.
         """
         assert packed.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
-        self.state, out = gcra_scan_packed(
+        track_cur_safety(self, compact, params_cur_safe)
+        self.state, self.exp_acc, out = gcra_scan_packed_acc(
             self.state,
+            self.exp_acc,
             packed
             if isinstance(packed, jax.Array)
             else jnp.asarray(packed, jnp.int32),
@@ -227,6 +300,7 @@ class BucketTable:
         quantity: int = 1,
         with_degen: bool = True,
         compact=False,
+        params_cur_safe: bool = False,
     ) -> jax.Array:
         """K stacked micro-batches of 8-byte request words (i64[K, B],
         tk_assemble_ids layout) against resident `id_rows` (a raw device
@@ -236,8 +310,10 @@ class BucketTable:
         if isinstance(id_rows, ResidentIdRows):
             id_rows = id_rows.rows_checked()
         assert words.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
-        self.state, out = gcra_scan_byid(
+        track_cur_safety(self, compact, params_cur_safe)
+        self.state, self.exp_acc, out = gcra_scan_byid_acc(
             self.state,
+            self.exp_acc,
             id_rows,
             words
             if isinstance(words, jax.Array)
@@ -257,6 +333,7 @@ class BucketTable:
         quantity: int = 1,
         with_degen: bool = True,
         compact=False,
+        params_cur_safe: bool = False,
     ) -> jax.Array:
         """K stacked micro-batches of RAW key ids (i32[K, B], negative =
         padding) against resident `id_rows`: 4 bytes per request on the
@@ -266,8 +343,10 @@ class BucketTable:
         if isinstance(id_rows, ResidentIdRows):
             id_rows = id_rows.rows_checked()
         assert ids.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
-        self.state, out = gcra_scan_ids(
+        track_cur_safety(self, compact, params_cur_safe)
+        self.state, self.exp_acc, out = gcra_scan_ids_acc(
             self.state,
+            self.exp_acc,
             id_rows,
             ids
             if isinstance(ids, jax.Array)
