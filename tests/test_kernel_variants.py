@@ -322,3 +322,180 @@ def test_byid_word_path_masks_unresolved_slot():
         # No other REAL slot is touched (suppressed writes are absorbed
         # by the scratch tail at the high end of the state array).
         assert (tat[1:32] == 0).all()
+
+
+def test_w32_variant_matches_compact(nondegen_batch):
+    """compact="w32" (one i32/request, device-packed wire values) must
+    reproduce the 4-plane compact output bit-for-bit under its
+    certificate and leave identical state."""
+    from throttlecrab_tpu.tpu.kernel import finish_w32, fits_w32_wire
+
+    slots, rank, is_last, em, tol, q, valid = nondegen_batch
+    assert fits_w32_wire(valid, em, tol, q, BASE + 30 * NS, int(tol.max()))
+    st1 = make_table()
+    st2 = make_table()
+    for now in (BASE, BASE, BASE + 2 * NS, BASE + 30 * NS):
+        st1, out_c = run(
+            st1, *nondegen_batch, now, with_degen=False, compact=True
+        )
+        st2, w = run(
+            st2, *nondegen_batch, now, with_degen=False, compact="w32"
+        )
+        w = np.asarray(w)
+        assert w.dtype == np.int32 and w.shape == (64,)
+        out_c = np.asarray(out_c)
+        al, rem, res, ret = finish_w32(w)
+        np.testing.assert_array_equal(al, out_c[0])
+        np.testing.assert_array_equal(rem, out_c[1])
+        np.testing.assert_array_equal(res, out_c[2])
+        np.testing.assert_array_equal(ret, out_c[3])
+    np.testing.assert_array_equal(np.asarray(st1)[:64], np.asarray(st2)[:64])
+
+
+def test_w32_field_edges_roundtrip():
+    """Wire values driven to their field maxima (remaining near 1023,
+    reset_s near 2047, retry_s > 0) survive the 32-bit packing exactly;
+    parameters past the bounds fail the certificate."""
+    from throttlecrab_tpu.tpu.kernel import (
+        W32_REM_MAX,
+        W32_RESET_MAX,
+        finish_w32,
+        fits_w32_wire,
+    )
+
+    B = 8
+    slots = np.arange(B, dtype=np.int32)
+    rank = np.zeros(B, np.int32)
+    is_last = np.ones(B, bool)
+    # burst 500 → fresh remaining 499; em 1s, tol 499s → reset ~500s.
+    # (The certificate's remaining bound is ~2x burst — a nearly-expired
+    # bucket's room approaches 2*tol — so burst 500 is the class of
+    # largest bursts w32 accepts: 2*499 = 998 <= 1023.)
+    em = np.full(B, NS, np.int64)
+    tol = em * 499
+    q = np.full(B, 1, np.int64)
+    valid = np.ones(B, bool)
+    assert fits_w32_wire(valid, em, tol, q, BASE, int(tol.max()))
+    st1, out_c = run(
+        make_table(), slots, rank, is_last, em, tol, q, valid, BASE,
+        with_degen=False, compact=True,
+    )
+    st2, w = run(
+        make_table(), slots, rank, is_last, em, tol, q, valid, BASE,
+        with_degen=False, compact="w32",
+    )
+    out_c = np.asarray(out_c)
+    al, rem, res, ret = finish_w32(np.asarray(w))
+    assert rem.max() == 499  # fresh-bucket headroom at the largest
+    assert res.max() >= 499  # reset holds whole seconds, not clipped
+    np.testing.assert_array_equal(al, out_c[0])
+    np.testing.assert_array_equal(rem, out_c[1])
+    np.testing.assert_array_equal(res, out_c[2])
+    np.testing.assert_array_equal(ret, out_c[3])
+
+    # remaining bound: burst 2000 → 1999 > W32_REM_MAX: must refuse.
+    assert not fits_w32_wire(
+        valid, em, em * 1999, q, BASE, int(em[0] * 1999)
+    )
+    # reset bound: tol 1100s twice over > W32_RESET_MAX seconds: refuse.
+    big = em * 1100
+    assert (2 * 1100) > W32_RESET_MAX
+    assert not fits_w32_wire(valid, em * 100, big, q, BASE, int(big[0]))
+    # A huge tolerance on an INVALID lane must not matter.
+    tol_mixed = tol.copy()
+    tol_mixed[3] = 1 << 62
+    v_mixed = valid.copy()
+    v_mixed[3] = False
+    assert fits_w32_wire(v_mixed, em, tol_mixed, q, BASE, int(tol.max()))
+    assert W32_REM_MAX == 1023 and W32_RESET_MAX == 2047
+
+
+def test_w32_respects_cross_launch_tol_hwm():
+    """A stored TAT from an earlier big-tolerance launch can push a later
+    launch's reset_s past the field width; the tol_hwm term in the
+    certificate must force the fallback, and the fallback values must
+    match the 4-plane path (differential on the same key)."""
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    T = 1_753_700_000 * NS
+    lim = TpuRateLimiter(capacity=256)
+    twin = TpuRateLimiter(capacity=256)
+    # burst 4000 with em 1s → tol ~3999s: valid, exceeds w32 widths →
+    # the launch itself is cur/4-plane, and tol_hwm records ~3999s.
+    for L in (lim, twin):
+        r = L.rate_limit_batch(["k"], 4000, 60, 60, 3999, T, wire=True)
+        assert bool(r.allowed[0])
+    assert lim.table.tol_hwm >= 3000 * NS
+
+    # Small-tol traffic on the SAME key: its stored TAT is ~T + 3999s,
+    # so reset_s ≈ 4000 s > 2047 — w32 must NOT be chosen.
+    h = lim.dispatch_many([(["k"], 10, 100, 60, 1, T + NS)], wire=True)
+    assert not getattr(h, "_w32", True)
+    res = h.fetch()[0]
+    ref = twin.rate_limit_batch(["k"], 10, 100, 60, 1, T + NS, wire=True)
+    np.testing.assert_array_equal(res.allowed, ref.allowed)
+    np.testing.assert_array_equal(res.remaining, ref.remaining)
+    np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
+    np.testing.assert_array_equal(res.retry_after_s, ref.retry_after_s)
+    assert int(res.reset_after_s[0]) > 2047  # the field would have clipped
+
+
+def test_w32_refuses_clock_regression():
+    """A launch timestamped earlier than a prior launch can carry
+    reset_s past the w32 field width (stored TAT ~ prior now + tol);
+    the now_hwm guard must forfeit w32 and the fallback must match the
+    4-plane twin exactly."""
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    T = 1_753_700_000 * NS
+    lim = TpuRateLimiter(capacity=128)
+    twin = TpuRateLimiter(capacity=128)
+    # Fill "k" at a LATER clock with tol ~1000s (w32-certifiable).
+    for L in (lim, twin):
+        r = L.rate_limit_batch(
+            ["k"], 1000, 60, 60, 999, T + 3600 * NS, wire=True
+        )
+        assert bool(r.allowed[0])
+    assert lim.table.now_hwm == T + 3600 * NS
+
+    # Regressed clock: stored TAT ~ T+4600s → reset_s ~ 4600 > 2047.
+    h = lim.dispatch_many([(["k"], 10, 100, 60, 1, T)], wire=True)
+    assert not getattr(h, "_w32", True)
+    res = h.fetch()[0]
+    ref = twin.rate_limit_batch(["k"], 10, 100, 60, 1, T, wire=True)
+    np.testing.assert_array_equal(res.allowed, ref.allowed)
+    np.testing.assert_array_equal(res.remaining, ref.remaining)
+    np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
+    np.testing.assert_array_equal(res.retry_after_s, ref.retry_after_s)
+    assert int(res.reset_after_s[0]) > 2047  # would not have fit w32
+
+
+def test_w32_snapshot_restore_carries_tol_hwm(tmp_path):
+    """Restored state must carry its write-time tolerances into the
+    restored table's tol_hwm (recovered as expiry - tat), or a later
+    small-tol w32 launch would wrap its reset field against the
+    restored TATs."""
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+    from throttlecrab_tpu.tpu.snapshot import load_snapshot, save_snapshot
+
+    T = 1_753_700_000 * NS
+    lim = TpuRateLimiter(capacity=128)
+    # tol ~2099s: past the w32 reset field on its own, so any restored
+    # TAT near T + 2099s forces the fallback for small-tol traffic too.
+    r = lim.rate_limit_batch(["k"], 2100, 60, 60, 2099, T, wire=True)
+    assert bool(r.allowed[0])
+    path = tmp_path / "bigtol.npz"
+    save_snapshot(lim, path)
+
+    lim2 = TpuRateLimiter(capacity=128)
+    assert load_snapshot(lim2, path, now_ns=T + NS) == 1
+    assert lim2.table.tol_hwm >= 2000 * NS  # write-time tol recovered
+
+    twin = TpuRateLimiter(capacity=128)
+    twin.rate_limit_batch(["k"], 2100, 60, 60, 2099, T, wire=True)
+    h = lim2.dispatch_many([(["k"], 10, 100, 60, 1, T + NS)], wire=True)
+    assert not getattr(h, "_w32", True)
+    res = h.fetch()[0]
+    ref = twin.rate_limit_batch(["k"], 10, 100, 60, 1, T + NS, wire=True)
+    np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
+    np.testing.assert_array_equal(res.remaining, ref.remaining)

@@ -358,6 +358,12 @@ class NativeRedisTransport:
 
         with self.limiter_lock:
             policy.record_ops(n_ops)
+            # Did the throttled drain just hit the device?  Then the
+            # pre-sweep force drain below would be a redundant second
+            # blocking fetch (same lock hold, nothing launched between).
+            drained = getattr(
+                self.limiter, "expired_hits_fetch_due", lambda t: False
+            )(now_ns)
             feed_expired_hits(policy, self.limiter, now_ns)
             live = len(self.limiter)
             capacity = getattr(self.limiter, "total_capacity", 1 << 62)
@@ -366,7 +372,8 @@ class NativeRedisTransport:
             # Attribute on-device hits to the window this sweep closes
             # (see engine._maybe_sweep); this driver thread already
             # sweeps inline, so the blocking fetch is acceptable here.
-            feed_expired_hits(policy, self.limiter, now_ns, force=True)
+            if not drained:
+                feed_expired_hits(policy, self.limiter, now_ns, force=True)
             freed = self.limiter.sweep(now_ns)
             policy.after_sweep(now_ns, freed, live)
         if self.metrics is not None:

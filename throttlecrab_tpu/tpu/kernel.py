@@ -171,6 +171,84 @@ def fits_cur_wire(tolerance, now_ns) -> bool:
     )
 
 
+# compact="w32" field widths: allowed(1) + remaining(10) + reset_s(11)
+# + retry_s(10) = 32.  The bounds are generous for real rate limits
+# (remaining <= 1023 tokens of headroom, reset <= ~34 min, retry <=
+# ~17 min); anything bigger falls back to compact="cur".
+W32_REM_MAX = (1 << 10) - 1
+W32_RESET_MAX = (1 << 11) - 1
+W32_RETRY_MAX = (1 << 10) - 1
+
+
+def fits_w32_wire(
+    valid, emission, tolerance, quantity, now_ns, tol_hwm, now_hwm=0
+) -> bool:
+    """Certificate for the compact="w32" output mode (4 B/request).
+
+    Exactness needs every valid lane's wire values inside the packed
+    field widths.  From cur ∈ [now - max(em, tol), now + max(tol, hwm)]
+    (the kernel clamps t0 below, the allow condition bounds new TATs
+    above by now + tol, and every stored TAT is <= prior_now + hwm
+    where `tol_hwm` is the table's high-water mark of valid tolerances
+    ever launched — BucketTable.tol_hwm):
+
+      remaining <= (tol + max(em, tol)) // em   <= W32_REM_MAX
+      reset_s   <= (tol + hwm) // 1e9           <= W32_RESET_MAX
+      retry_s   <= (inc + max(hwm - tol, 0)) // 1e9 <= W32_RETRY_MAX
+
+    The stored-TAT bound `stored <= now + hwm` additionally needs this
+    launch's clock at or past every prior launch's (`now_ns >= now_hwm`
+    — BucketTable.now_hwm); a regressed clock can push reset_s past its
+    field by the regression amount, so it forfeits w32 (the cur tier
+    absorbs regressions fine).  Callers must ALSO hold the
+    with_degen=False certificate (has_degenerate) — the degenerate
+    views have no packable closed form — and now_ns >= 0.
+    """
+    import numpy as np
+
+    v = np.asarray(valid, bool)
+    if not bool(np.any(v)):
+        return True
+    if not 0 <= now_ns < (1 << 61):
+        return False
+    if now_ns < int(now_hwm):
+        return False
+    hwm = int(tol_hwm)
+    if hwm >= (1 << 61):
+        return False
+    em = np.where(v, np.asarray(emission, np.int64), 1)
+    tol = np.where(v, np.asarray(tolerance, np.int64), 0)
+    q = np.where(v, np.asarray(quantity, np.int64), 0)
+    hwm = max(hwm, int(tol.max(initial=0)))
+    em_safe = np.maximum(em, 1)  # degen-free cert guarantees em > 0
+    inc = em * q
+    rem_bound = (tol + np.maximum(em, tol)) // em_safe
+    reset_bound = (tol + hwm) // _NS_PER_SEC
+    retry_bound = (inc + np.maximum(hwm - tol, 0)) // _NS_PER_SEC
+    return bool(
+        (np.where(v, rem_bound, 0) <= W32_REM_MAX).all()
+        and (np.where(v, reset_bound, 0) <= W32_RESET_MAX).all()
+        and (np.where(v, retry_bound, 0) <= W32_RETRY_MAX).all()
+    )
+
+
+def finish_w32(words):
+    """Host-side unpack of the compact="w32" device output: i32 words →
+    (allowed, remaining, reset_after_secs, retry_after_secs), all i32 —
+    bit-exact to the 4-plane compact output on every valid lane (the
+    device packed the final values; this is three shifts and masks, no
+    reconstruction arithmetic)."""
+    import numpy as np
+
+    u = np.ascontiguousarray(words, np.int32).view(np.uint32)
+    return (
+        (u & 1).astype(np.int32),
+        ((u >> 1) & np.uint32(W32_REM_MAX)).astype(np.int32),
+        ((u >> 11) & np.uint32(W32_RESET_MAX)).astype(np.int32),
+        ((u >> 22) & np.uint32(W32_RETRY_MAX)).astype(np.int32),
+    )
+
+
 def cur_wire_safe(valid, tolerance, now_ns) -> bool:
     """Valid-lane-masked fits_cur_wire, for batches that carry rejected
     or padding lanes.
@@ -548,6 +626,21 @@ def _finish(
     if compact == "cur":
         assert cur is not None, 'compact="cur" requires with_degen=False'
         out = cur * 2 + allowed.astype(jnp.int64)
+    elif compact == "w32":
+        # 4 B/request: the four exact wire values packed into one i32 —
+        # allowed(1) | remaining(10) | reset_s(11) | retry_s(22..31).
+        # Legal only under fits_w32_wire (host-checked bounds keep every
+        # valid lane's fields inside their widths; invalid lanes may
+        # overflow within their own don't-care word).  Halves the fetch
+        # vs compact="cur"; the i64 divisions run on device (measured
+        # free on v5e — docs/tpu-launch-profile.md).
+        assert cur is not None, 'compact="w32" requires with_degen=False'
+        out = (
+            allowed.astype(jnp.int32)
+            | (remaining.astype(jnp.int32) << 1)
+            | ((reset_after // _NS_PER_SEC).astype(jnp.int32) << 11)
+            | ((retry_after // _NS_PER_SEC).astype(jnp.int32) << 22)
+        )
     elif compact:
         out = jnp.stack(
             [

@@ -255,6 +255,17 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
     # restored value sits in the proven-safe range (see track_cur_safety).
     if not tats_cur_safe(tats):
         limiter.table.cur_safe = False
+    # The w32 tier's tighter bound needs the tolerance high-water mark
+    # to cover restored state too: each entry's write-time tolerance is
+    # recoverable as expiry - tat (kernel _finish: expiry = tat + tol,
+    # saturated to i64max for never-expires — which correctly saturates
+    # the mark and disables w32).
+    note = getattr(limiter.table, "note_max_tolerance", None)
+    if note is not None:
+        restored_tol = max(
+            (e - t for t, e in zip(tats, expiries)), default=0
+        )
+        note(restored_tol if restored_tol < (1 << 62) else None)
 
     if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
         import jax

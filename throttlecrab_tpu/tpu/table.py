@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .sat import I64_MAX
 from .kernel import (
     EMPTY_EXPIRY,
     gcra_batch_acc,
@@ -58,8 +59,31 @@ def track_cur_safety(table, compact, params_cur_safe) -> None:
     `params_cur_safe=True`.  Dispatchers consult `table.cur_safe`
     before choosing the cur wire mode.
     """
-    if compact != "cur" and not params_cur_safe:
+    if compact not in ("cur", "w32") and not params_cur_safe:
+        # compact="w32" implies safety: its certificate (fits_w32_wire)
+        # bounds every valid tolerance to seconds-scale, far below 2^61.
         table.cur_safe = False
+
+
+def _host_max_now(now_ns):
+    """Max launch timestamp for BucketTable.note_launch_now — host
+    values only (a jax.Array reports unknown, saturating the mark)."""
+    if isinstance(now_ns, jax.Array):
+        return None
+    a = np.asarray(now_ns, np.int64)
+    return int(a.max(initial=0)) if a.ndim else int(a)
+
+
+def _host_max_tol(valid, tolerance):
+    """Masked max tolerance for BucketTable.note_max_tolerance — host
+    arrays only (a jax.Array would force a device sync, so it reports
+    unknown instead and the mark saturates)."""
+    if isinstance(tolerance, jax.Array) or isinstance(valid, jax.Array):
+        return None
+    v = np.asarray(valid, bool)
+    return int(
+        np.where(v, np.asarray(tolerance, np.int64), 0).max(initial=0)
+    )
 
 
 def tats_cur_safe(tats) -> bool:
@@ -128,6 +152,31 @@ class BucketTable:
         )
         with ctx:
             self.exp_acc = jnp.zeros((), jnp.int64)
+        # High-water marks backing the compact="w32" certificate
+        # (kernel.fits_w32_wire): every stored TAT is <= its writing
+        # launch's now + tol <= now_hwm + tol_hwm, so a later launch at
+        # now >= now_hwm can bound its reset/retry fields.  A launch at
+        # an EARLIER now (clock regression / caller-supplied timestamp)
+        # breaks that inequality, so w32 also requires now >= now_hwm.
+        # Launches that cannot report their values saturate the marks.
+        self.tol_hwm = 0
+        self.now_hwm = 0
+
+    def note_max_tolerance(self, max_tol) -> None:
+        """Record a launch's max valid-lane tolerance (None = unknown:
+        saturates the mark, disabling w32 until the table is rebuilt)."""
+        if max_tol is None:
+            self.tol_hwm = I64_MAX
+        else:
+            self.tol_hwm = max(self.tol_hwm, int(max_tol))
+
+    def note_launch_now(self, now_ns) -> None:
+        """Record a launch's max timestamp (None = unknown: saturates,
+        disabling w32 — `now >= now_hwm` can then never hold)."""
+        if now_ns is None:
+            self.now_hwm = I64_MAX
+        else:
+            self.now_hwm = max(self.now_hwm, int(now_ns))
 
     def expired_hits(self) -> int:
         """Total expired-hit count since construction.  One scalar
@@ -182,6 +231,8 @@ class BucketTable:
         """
         assert len(slots) <= self.SCRATCH, "batch exceeds scratch region"
         track_cur_safety(self, compact, params_cur_safe)
+        self.note_max_tolerance(_host_max_tol(valid, tolerance))
+        self.note_launch_now(_host_max_now(now_ns))
         self.state, self.exp_acc, out = gcra_batch_acc(
             self.state,
             self.exp_acc,
@@ -216,6 +267,8 @@ class BucketTable:
         launch; returns the [K, 4, B] stacked device output."""
         assert slots.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
         track_cur_safety(self, compact, params_cur_safe)
+        self.note_max_tolerance(_host_max_tol(valid, tolerance))
+        self.note_launch_now(_host_max_now(now_ns))
         self.state, self.exp_acc, out = gcra_scan_acc(
             self.state,
             self.exp_acc,
@@ -239,6 +292,7 @@ class BucketTable:
         with_degen: bool = True,
         compact=False,
         params_cur_safe: bool = False,
+        max_tolerance=None,
     ) -> jax.Array:
         """K stacked micro-batches from ONE packed i32[K, B, PACK_WIDTH]
         buffer (see kernel.pack_requests); `now_ns` is i64[K].
@@ -257,6 +311,10 @@ class BucketTable:
         """
         assert packed.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
         track_cur_safety(self, compact, params_cur_safe)
+        # Packed rows hide the tolerances; the caller reports its masked
+        # max (None saturates the mark — see note_max_tolerance).
+        self.note_max_tolerance(max_tolerance)
+        self.note_launch_now(_host_max_now(now_ns))
         self.state, self.exp_acc, out = gcra_scan_packed_acc(
             self.state,
             self.exp_acc,
@@ -288,6 +346,14 @@ class BucketTable:
         rows = jax.device_put(
             pack_id_rows(slots, emission, tolerance), self.device
         )
+        # The rows' tolerances bound every future by-id write, so noting
+        # them here covers all subsequent check_many_byid/ids launches
+        # (which therefore skip per-launch reporting).
+        self.note_max_tolerance(
+            None
+            if isinstance(tolerance, jax.Array)
+            else int(np.max(np.asarray(tolerance, np.int64), initial=0))
+        )
         if keymap is None:
             return rows
         return ResidentIdRows(rows, keymap)
@@ -311,6 +377,7 @@ class BucketTable:
             id_rows = id_rows.rows_checked()
         assert words.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
         track_cur_safety(self, compact, params_cur_safe)
+        self.note_launch_now(_host_max_now(now_ns))
         self.state, self.exp_acc, out = gcra_scan_byid_acc(
             self.state,
             self.exp_acc,
@@ -344,6 +411,7 @@ class BucketTable:
             id_rows = id_rows.rows_checked()
         assert ids.shape[1] <= self.SCRATCH, "batch exceeds scratch region"
         track_cur_safety(self, compact, params_cur_safe)
+        self.note_launch_now(_host_max_now(now_ns))
         self.state, self.exp_acc, out = gcra_scan_ids_acc(
             self.state,
             self.exp_acc,
