@@ -175,3 +175,29 @@ for mode in ("full", "noidrow", "nostate", "noscatter", "elementwise"):
         f"({dt/K*1e3:6.3f} ms/batch, {K*B/dt/1e6:6.2f} M dec/s)",
         flush=True,
     )
+
+# Width ablation: the kernels read only row columns 0-4, so the
+# resident parameter gather can shrink 8 -> 5 i32 per id (32 -> 20 B).
+# Whether the narrower gather buys anything depends on the chip's tile
+# padding — measure, don't guess (round-4 idea list).
+scan = make_scan("full")
+for width in (8, 5):
+    rows_w = jax.device_put(
+        pack_id_rows(slots_all, em_all, tol_all, width=width), dev
+    )
+    state = make_state()
+    staged = [stage() for _ in range(R)]
+    state, out = scan(state, rows_w, staged[0], now)
+    np.asarray(_sum(out))
+    t0 = time.perf_counter()
+    checks = []
+    for wd in staged:
+        state, out = scan(state, rows_w, wd, now)
+        checks.append(_sum(out))
+    np.asarray(sum(checks))
+    dt = (time.perf_counter() - t0) / R
+    print(
+        f"width {width}     : {dt*1e3:8.2f} ms/launch  "
+        f"({dt/K*1e3:6.3f} ms/batch, {K*B/dt/1e6:6.2f} M dec/s)",
+        flush=True,
+    )

@@ -792,14 +792,21 @@ def gcra_scan_packed(state, packed, now, *, with_degen=True, compact=False):
 IDROW_WIDTH = 8
 
 
-def pack_id_rows(slots, emission, tolerance):
+def pack_id_rows(slots, emission, tolerance, width=IDROW_WIDTH):
     """Host-side build of the resident by-id parameter rows:
-    i32[n, IDROW_WIDTH] = [slot, em_lo, em_hi, tol_lo, tol_hi, 0, 0, 0].
+    i32[n, width] = [slot, em_lo, em_hi, tol_lo, tol_hi, pad...].
+
+    The by-id kernels read only columns 0-4, so any width >= 5 works;
+    the default keeps the measured-on-hardware 8-wide layout
+    (scripts/probe_byid_ablation.py's width ablation measures whether
+    the narrower gather buys anything on a real chip).
     """
     import numpy as np
 
+    if width < 5:
+        raise ValueError("id rows need at least 5 columns")
     n = len(slots)
-    rows = np.zeros((n, IDROW_WIDTH), np.int32)
+    rows = np.zeros((n, width), np.int32)
     rows[:, 0] = slots
     for base, arr in ((1, emission), (3, tolerance)):
         a = np.asarray(arr, np.int64)
