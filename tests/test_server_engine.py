@@ -260,3 +260,48 @@ def test_dispatch_failure_fails_only_its_window():
     first, second = run(main())
     assert all(isinstance(r, ThrottleError) for r in first)
     assert all(r.allowed for r in second)
+
+
+def test_adaptive_expired_ratio_fires_engine_sweep():
+    """End-to-end adaptive trigger: traffic landing on expired entries
+    feeds the kernel's device-side hit counter through the engine's
+    drain (feed_expired_hits) into AdaptivePolicy, whose expired-ratio
+    trigger fires a sweep BEFORE the 5 s time trigger could."""
+    from throttlecrab_tpu.tpu.cleanup import AdaptivePolicy
+
+    async def main():
+        clock = VirtualClock()
+        policy = AdaptivePolicy()
+        limiter = TpuRateLimiter(capacity=1024)
+        engine = BatchingEngine(
+            limiter, batch_size=128, max_linger_us=500,
+            cleanup_policy=policy, now_fn=clock,
+        )
+        # 120 keys with ~1 s TTLs.
+        await asyncio.gather(*[
+            engine.throttle(req(key=f"e{i}", burst=1, count=1, period=1))
+            for i in range(120)
+        ])
+        assert len(limiter) == 120
+        # Expire them all; revisit 60 within the same policy window
+        # (+2 s < the 5 s default interval, so only the ratio trigger
+        # can fire: >50 hits, 60/120 = 0.5 > 0.25).
+        clock.now += 2 * NS
+        await asyncio.gather(*[
+            engine.throttle(req(key=f"e{i}", burst=1, count=1, period=1))
+            for i in range(60)
+        ])
+        # One more flush so the drained count reaches should_clean
+        # (the hit fetch is throttled to 1/s and runs on the executor).
+        clock.now += int(1.2 * NS)
+        await engine.throttle(req(key="tick"))
+        await asyncio.sleep(0.05)  # let the executor sweep land
+        return limiter, policy
+
+    limiter, policy = run(main())
+    # The sweep collected the 60 still-expired entries (the revisited 60
+    # were refreshed by their hits, exactly like the reference's
+    # set_if_not_exists re-insert) and reset the policy's hit count.
+    assert policy._last_total > 0  # after_sweep ran
+    assert policy._expired == 0
+    assert len(limiter) <= 62  # 120 + tick - 60 swept (y may survive)

@@ -421,3 +421,60 @@ def test_native_wire_window_cur_matches_python_path():
     np.testing.assert_array_equal(res.remaining, ref.remaining)
     np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
     np.testing.assert_array_equal(res.retry_after_s, ref.retry_after_s)
+
+
+@pytest.mark.parametrize("seed", range(2000, 2008))
+def test_wire_tier_selection_differential_fuzz(seed):
+    """Random wire traffic through dispatch_many: whatever output tier
+    the dispatcher picks per window (w32 / cur / 4-plane, including
+    tol_hwm crossings from occasional big-tolerance keys and degen
+    probes) must produce the 4-plane twin's exact wire values."""
+    rng = np.random.default_rng(seed)
+    lim = TpuRateLimiter(capacity=512)
+    twin = TpuRateLimiter(capacity=512)
+    pool = [f"f{seed}k{i}" for i in range(10)]
+    params = {}
+    for k in pool:
+        r = rng.random()
+        if r < 0.15:  # big tolerance: forfeits w32, bumps tol_hwm
+            params[k] = (int(rng.integers(2500, 10_000)), 60, 60)
+        elif r < 0.25:  # degen probe material (quantity drawn 0 below)
+            params[k] = (1, 1, 1)
+        else:
+            params[k] = (
+                int(rng.integers(2, 200)),
+                int(rng.integers(1, 1000)),
+                int(rng.integers(1, 600)),
+            )
+    tiers = set()
+    now = T0
+    for step in range(8):
+        n = int(rng.integers(2, 24))
+        keys = [pool[rng.integers(len(pool))] for _ in range(n)]
+        b = [params[k][0] for k in keys]
+        c = [params[k][1] for k in keys]
+        p = [params[k][2] for k in keys]
+        q = [
+            0 if (params[k][0] == 1 and rng.random() < 0.5) else 1
+            for k in keys
+        ]
+        batch = (keys, b, c, p, q, now)
+        h = lim.dispatch_many([batch], wire=True)
+        tiers.add(
+            "w32" if getattr(h, "_w32", False)
+            else ("cur" if getattr(h, "_cur", False) else "planes")
+        )
+        res = h.fetch()[0]
+        ref = twin.rate_limit_batch(*batch, wire=True)
+        ctx = f"seed{seed} step{step}"
+        np.testing.assert_array_equal(res.allowed, ref.allowed, ctx)
+        np.testing.assert_array_equal(res.remaining, ref.remaining, ctx)
+        np.testing.assert_array_equal(
+            res.reset_after_s, ref.reset_after_s, ctx
+        )
+        np.testing.assert_array_equal(
+            res.retry_after_s, ref.retry_after_s, ctx
+        )
+        np.testing.assert_array_equal(res.status, ref.status, ctx)
+        now += int(rng.integers(0, 2 * NS))
+    assert tiers  # at least one window decided (tier mix varies by seed)
