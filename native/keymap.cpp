@@ -535,13 +535,19 @@ constexpr uint8_t STATUS_OK = 0;
 constexpr uint8_t STATUS_NEGATIVE_QUANTITY = 1;
 constexpr uint8_t STATUS_INVALID_PARAMS = 2;
 
+// agg (i64[4], may be null): aggregate bounds over STATUS_OK lanes for
+// the caller's O(1) compact="w32" certificate (kernel.fits_w32_wire's
+// native twin): [max_tol, min_tol, max_inc (saturated), max of the
+// per-lane remaining bound (tol + max(em, tol)) / em].  Lanes the
+// validator rejects never reach the kernel, so they are excluded.
 int64_t tk_prepare_batch(void* h, const char* keys, const int64_t* offsets,
                          int64_t n, const int64_t* params, int32_t* out,
-                         uint8_t* status) {
+                         uint8_t* status, int64_t* agg) {
     KeyMap* m = static_cast<KeyMap*>(h);
     m->batch_stamp++;
     const uint64_t stamp = m->batch_stamp;
     int64_t flags = 0;
+    int64_t max_tol = 0, min_tol = INT64_MAX, max_inc = 0, max_remb = 0;
     // Per-slot first-occurrence params for conflict detection, reset via
     // the same stamp the segment tracking uses.
     for (int64_t i = 0; i < n; i++) {
@@ -591,6 +597,25 @@ int64_t tk_prepare_batch(void* h, const char* keys, const int64_t* offsets,
         // time.)
         if (tol >= (int64_t(1) << 61)) flags |= TK_PREP_BIGTOL;
 
+        // w32-certificate aggregates (see header comment).
+        if (tol > max_tol) max_tol = tol;
+        if (tol < min_tol) min_tol = tol;
+        {
+            // Saturating em * qty (the bound only needs the clamp).
+            const double inc_f =
+                static_cast<double>(em) * static_cast<double>(qty);
+            const int64_t inc = inc_f >= 9223372036854775807.0
+                                    ? INT64_MAX
+                                    : static_cast<int64_t>(inc_f);
+            if (inc > max_inc) max_inc = inc;
+            if (em > 0 && tol >= 0 && tol < (int64_t(1) << 61)) {
+                const int64_t remb = (tol + (em > tol ? em : tol)) / em;
+                if (remb > max_remb) max_remb = remb;
+            } else {
+                max_remb = INT64_MAX;  // degen/bigtol lane: refuse w32
+            }
+        }
+
         const char* key = keys + offsets[i];
         const int64_t len = offsets[i + 1] - offsets[i];
         bool is_full = false;
@@ -637,6 +662,12 @@ int64_t tk_prepare_batch(void* h, const char* keys, const int64_t* offsets,
         w[6] = static_cast<int32_t>(tol >> 32);
         w[7] = static_cast<int32_t>(qty & 0xFFFFFFFFll);
         w[8] = static_cast<int32_t>(qty >> 32);
+    }
+    if (agg) {
+        agg[0] = max_tol;
+        agg[1] = min_tol == INT64_MAX ? 0 : min_tol;
+        agg[2] = max_inc;
+        agg[3] = max_remb;
     }
     return flags;
 }

@@ -231,3 +231,60 @@ def test_prepare_batch_flags_big_tolerance():
         np.array([[10, 100, 60, 1]], np.int64),
     )
     assert status[0] == 0 and not (flags & PREP_BIGTOL)
+
+
+def test_prepare_batch_agg_matches_python_certificate():
+    """tk_prepare_batch's agg output must reproduce the Python-side
+    valid-lane aggregates, and the O(1) certificate built from it must
+    agree with the array-form fits_w32_wire on the same batch."""
+    from throttlecrab_tpu.native import NativeKeyMap, native_available
+    from throttlecrab_tpu.tpu.kernel import (
+        fits_w32_wire,
+        fits_w32_wire_agg,
+    )
+    from throttlecrab_tpu.tpu.limiter import derive_params
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    now = 1_753_700_000 * 1_000_000_000
+    cases = [
+        # (burst, count, period, qty) rows incl. invalid + degen lanes
+        [(10, 100, 60, 1), (0, 1, 1, 1), (500, 60, 60, 2)],
+        [(2100, 60, 60, 1), (5, 10, 10, 1)],     # big tol: w32 refused
+        [(3, 3, 3, 1)],
+        [(0, 0, 0, 0)],                           # all-invalid frame
+    ]
+    for rows in cases:
+        km = NativeKeyMap(64)
+        keys = [b"a%d" % i for i in range(len(rows))]
+        blob = b"".join(keys)
+        offsets = np.cumsum([0] + [len(k) for k in keys]).astype(np.int64)
+        params = np.array(rows, np.int64).reshape(len(rows), 4)
+        agg = np.empty(4, np.int64)
+        _, status, flags = km.prepare_batch(blob, offsets, params, agg=agg)
+
+        valid = status == 0
+        em, tol, _ = derive_params(params[:, 0], params[:, 1], params[:, 2])
+        q = params[:, 3]
+        # Python twins of the C aggregates (valid lanes only).
+        if valid.any():
+            vt = tol[valid]
+            assert int(agg[0]) == int(vt.max())
+            assert int(agg[1]) == int(vt.min())
+            # Integer-domain saturating em*qty twin (a float clamp at
+            # (1<<63)-1 rounds to 2^63 and the i64 cast would wrap).
+            inc = [
+                min(int(e) * int(qq), (1 << 63) - 1)
+                for e, qq in zip(em[valid], q[valid])
+            ]
+            assert int(agg[2]) == max(inc)
+        else:
+            assert agg[0] == 0 and agg[1] == 0 and agg[2] == 0
+
+        got = fits_w32_wire_agg(
+            agg[0], agg[1], agg[2], agg[3], now, 0, 0
+        )
+        want = fits_w32_wire(valid, em, tol, q, now, 0, 0)
+        # The agg form may only be MORE conservative, never less; on
+        # these cases (uniform-ish lanes) it matches exactly.
+        assert got == want, rows

@@ -119,7 +119,7 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.tk_prepare_batch.restype = ctypes.c_int64
     lib.tk_prepare_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
     ]
     lib.tk_export_sizes.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -550,6 +550,7 @@ class NativeKeyMap:
         offsets: np.ndarray,
         params: np.ndarray,
         out: Optional[np.ndarray] = None,
+        agg: Optional[np.ndarray] = None,
     ):
         """The fully-native serving prep: validate + derive GCRA params
         (exact f64 pipeline) + resolve slots + segment structure + packed
@@ -560,7 +561,11 @@ class NativeKeyMap:
         status u8[n], flags).  flags & (PREP_CONFLICT | PREP_FULL) means
         the caller must fall back to the Python path (mid-batch param
         change / table growth); PREP_DEGEN means decide with the exact
-        kernel (with_degen=True)."""
+        kernel (with_degen=True).
+
+        `agg` (i64[4], optional) receives the valid-lane bounds for the
+        dispatcher's O(1) w32 certificate: [max_tol, min_tol, max_inc,
+        max remaining-bound] (kernel.fits_w32_wire_agg consumes it)."""
         from .tpu.kernel import PACK_WIDTH
 
         n = len(offsets) - 1
@@ -571,6 +576,11 @@ class NativeKeyMap:
         if out is None:
             out = np.empty((n, PACK_WIDTH), np.int32)
         status = np.empty(n, np.uint8)
+        if agg is not None and (
+            agg.shape != (4,) or agg.dtype != np.int64
+            or not agg.flags.c_contiguous
+        ):
+            raise ValueError("agg must be a C-contiguous i64[4] buffer")
         flags = self._lib.tk_prepare_batch(
             self._h,
             key_blob,
@@ -579,6 +589,7 @@ class NativeKeyMap:
             params.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p),
             status.ctypes.data_as(ctypes.c_void_p),
+            agg.ctypes.data_as(ctypes.c_void_p) if agg is not None else None,
         )
         return out, status, int(flags)
 

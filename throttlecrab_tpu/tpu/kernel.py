@@ -232,6 +232,29 @@ def fits_w32_wire(
     )
 
 
+def fits_w32_wire_agg(
+    max_tol, min_tol, max_inc, rem_bound, now_ns, tol_hwm, now_hwm=0
+) -> bool:
+    """fits_w32_wire from precomputed valid-lane aggregates — the O(1)
+    form fed by the C++ prep's `agg` output (native/keymap.cpp
+    tk_prepare_batch), so the native serving path never re-walks the
+    packed rows in Python.  `max_inc + (hwm - min_tol)` is the array
+    version's per-lane retry bound taken conservatively (a lane's own
+    inc with another lane's smaller tol can only over-estimate)."""
+    if not 0 <= now_ns < (1 << 61) or now_ns < int(now_hwm):
+        return False
+    hwm = int(tol_hwm)
+    if hwm >= (1 << 61):
+        return False
+    hwm = max(hwm, int(max_tol))
+    if int(rem_bound) > W32_REM_MAX:
+        return False
+    if (int(max_tol) + hwm) // _NS_PER_SEC > W32_RESET_MAX:
+        return False
+    retry_bound = int(max_inc) + max(hwm - int(min_tol), 0)
+    return retry_bound // _NS_PER_SEC <= W32_RETRY_MAX
+
+
 def finish_w32(words):
     """Host-side unpack of the compact="w32" device output: i32 words →
     (allowed, remaining, reset_after_secs, retry_after_secs), all i32 —

@@ -788,12 +788,29 @@ class TpuRateLimiter(ScalarCompatMixin):
         width = self.MIN_PAD
         any_degen = False
         any_bigtol = False
+        # Per-window w32-certificate aggregates, folded across frames
+        # (C++ computes them per frame during the same prep pass).
+        agg = np.empty(4, np.int64)
+        max_tol = 0
+        min_tol = 1 << 62
+        max_inc = 0
+        rem_bound = 0
         for blob, offsets, params in frames:
-            packed, status, flags = km.prepare_batch(blob, offsets, params)
+            packed, status, flags = km.prepare_batch(
+                blob, offsets, params, agg=agg
+            )
             if flags & (PREP_CONFLICT | PREP_FULL):
                 return None
             any_degen = any_degen or bool(flags & PREP_DEGEN)
             any_bigtol = any_bigtol or bool(flags & PREP_BIGTOL)
+            max_tol = max(max_tol, int(agg[0]))
+            # agg[0] > 0 ⇔ the frame had a valid lane with tol > 0
+            # (tol <= 0 lanes carry PREP_DEGEN, and any_degen refuses
+            # w32 outright, so the 0-sentinel min never leaks in).
+            if int(agg[0]) > 0:
+                min_tol = min(min_tol, int(agg[1]))
+            max_inc = max(max_inc, int(agg[2]))
+            rem_bound = max(rem_bound, int(agg[3]))
             prepared.append((packed, status, params))
             n = len(status)
             width = max(width, 1 << max(n - 1, 0).bit_length())
@@ -823,23 +840,15 @@ class TpuRateLimiter(ScalarCompatMixin):
             stack[j, : len(packed)] = packed
 
         # w32 tier (4 B/request, device-packed exact wire values): the
-        # params live in the C++-packed rows, so rebuild the masked i64
-        # columns for the certificate — a few vectorized passes over
-        # [K, B] i32s, repaid 5x by the halved fetch on the tunnel.
-        def col64(lo, hi):
-            return (stack[..., hi].astype(np.int64) << 32) | (
-                stack[..., lo].astype(np.int64) & 0xFFFFFFFF
-            )
-
-        vmask = (stack[..., 2] & 2) != 0
-        tol64 = col64(5, 6)
-        max_tol = int(np.where(vmask, tol64, 0).max(initial=0))
+        # certificate runs on the C++ prep's aggregates — no Python pass
+        # over the rows, and the halved fetch repays the bookkeeping
+        # many times over on the tunnel.
         use_w32 = False
-        if not any_degen and not any_bigtol and 0 <= now_ns < (1 << 61):
-            from .kernel import fits_w32_wire
+        if not any_degen and not any_bigtol:
+            from .kernel import fits_w32_wire_agg
 
-            use_w32 = fits_w32_wire(
-                vmask, col64(3, 4), tol64, col64(7, 8), now_ns,
+            use_w32 = fits_w32_wire_agg(
+                max_tol, min_tol, max_inc, rem_bound, now_ns,
                 self.table.tol_hwm, self.table.now_hwm,
             )
         use_cur = use_cur and not use_w32
