@@ -146,14 +146,18 @@ def main() -> int:
     ap.add_argument("--no-resident", action="store_true",
                     help="skip the device-resident kernel-ceiling "
                          "measurement")
-    ap.add_argument("--segment", choices=("auto", "device", "host"),
+    ap.add_argument("--segment",
+                    choices=("auto", "device20", "device", "host"),
                     default="auto",
-                    help="byid path: derive duplicate-segment structure "
-                         "on-device from raw 4 B ids, or ship host-built "
-                         "8 B words (tk_assemble_ids).  auto = device on "
-                         "TPU (sort ~0.09 ms/batch, saves 4 B/request on "
-                         "the serialized tunnel), host elsewhere (the "
-                         "1-vCPU XLA sort costs more than it saves)")
+                    help="byid path: device20 = 20-bit packed ids "
+                         "(2.5 B/request, tables < 2^20-1 keys) with "
+                         "on-device segment derivation; device = raw "
+                         "4 B ids, segments on-device; host = 8 B words "
+                         "built by C++ tk_assemble_ids.  auto = device20 "
+                         "on TPU when the table fits (the sort costs "
+                         "~0.09 ms/batch; wire bytes are the ceiling "
+                         "through the serialized tunnel), host elsewhere "
+                         "(the 1-vCPU XLA sort costs more than it saves)")
     ap.add_argument("--pallas", action="store_true",
                     help="route table row gather/scatter through the "
                          "Pallas DMA kernels (tpu/pallas_ops.py)")
@@ -258,14 +262,26 @@ def main() -> int:
     }
 
     if path == "byid":
+        from throttlecrab_tpu.tpu.kernel import IDS20_SENTINEL
+
         segment = args.segment
         if segment == "auto":
-            segment = "device" if device.platform == "tpu" else "host"
+            segment = (
+                ("device20" if n_keys < IDS20_SENTINEL else "device")
+                if device.platform == "tpu"
+                else "host"
+            )
+        if segment == "device20" and n_keys >= IDS20_SENTINEL:
+            print(
+                "table too large for 20-bit ids; using raw 4 B ids",
+                file=sys.stderr,
+            )
+            segment = "device"
         extra["segment"] = segment
         rate = run_byid(
             limiter, keys, em_all, tol_all, rng, n_keys, depth,
             args.pipe, warm_launches, timed_launches, args.profile,
-            not args.no_resident, segment == "device", extra,
+            not args.no_resident, segment, extra,
         )
     elif path == "packed":
         rate = run_packed(
@@ -412,7 +428,7 @@ def _timed_trials(
 
 def run_byid(
     limiter, keys, em_all, tol_all, rng, n_keys, depth, pipe,
-    warm_launches, timed_launches, profile_dir, resident, dev_segment,
+    warm_launches, timed_launches, profile_dir, resident, segment,
     extra,
 ):
     """The minimum-wire-bytes path: resident per-key parameter rows +
@@ -438,6 +454,10 @@ def run_byid(
     km = limiter.keymap
     table = limiter.table
     per_launch = BATCH * depth
+    dev_segment = segment in ("device", "device20")
+    ids20 = segment == "device20"
+    if ids20:
+        from throttlecrab_tpu.tpu.kernel import pack_ids20
 
     # Untimed setup: intern the key universe, resolve slots, upload the
     # per-id parameter rows (config state, resident across launches).
@@ -474,6 +494,12 @@ def run_byid(
 
     def dispatch(ids, now_ns):
         now_arr = np.full(depth, now_ns, np.int64)
+        if ids20:
+            out = table.check_many_ids20(
+                id_rows, pack_ids20(ids.reshape(depth, BATCH)), now_arr,
+                **common,
+            )
+            return ids, out, now_ns
         if dev_segment:
             out = table.check_many_ids(
                 id_rows, ids.reshape(depth, BATCH), now_arr, **common

@@ -609,7 +609,18 @@ int64_t tk_prepare_batch(void* h, const char* keys, const int64_t* offsets,
                                     : static_cast<int64_t>(inc_f);
             if (inc > max_inc) max_inc = inc;
             if (em > 0 && tol >= 0 && tol < (int64_t(1) << 61)) {
-                const int64_t remb = (tol + (em > tol ? em : tol)) / em;
+                // Saturating sum: em is only bounded by i64, so
+                // tol + em can overflow (UB on signed i64) — the same
+                // double-probe pattern as max_inc above.  (Such lanes
+                // are also PREP_DEGEN via the big-inc certificate, but
+                // the aggregate must stay well-defined regardless.)
+                const int64_t big = em > tol ? em : tol;
+                const int64_t room =
+                    static_cast<double>(tol) + static_cast<double>(big)
+                            >= 9223372036854775807.0
+                        ? INT64_MAX
+                        : tol + big;
+                const int64_t remb = room / em;
                 if (remb > max_remb) max_remb = remb;
             } else {
                 max_remb = INT64_MAX;  // degen/bigtol lane: refuse w32

@@ -475,3 +475,151 @@ class TestByIdPath:
                 rows, np.array([[1]], np.int32), now, 1,
                 with_degen=False, compact="cur",
             )
+
+
+class TestIds20Stream:
+    def test_pack_ids20_layout_and_guards(self):
+        from throttlecrab_tpu.tpu.kernel import (
+            IDS20_SENTINEL,
+            pack_ids20,
+        )
+
+        ids = np.array([[0, 1, 0xFFFF, 0x9FFFE, -1, 7, 8, 9]], np.int32)
+        buf = pack_ids20(ids)
+        assert buf.dtype == np.uint16 and buf.shape == (1, 8 + 2)
+        # Low 16 bits verbatim; padding becomes the all-ones sentinel.
+        assert buf[0, 2] == 0xFFFF and buf[0, 3] == 0xFFFE
+        assert buf[0, 4] == IDS20_SENTINEL & 0xFFFF
+        # High nibbles packed 4-per-u16 in lane order.
+        assert buf[0, 8] == (0x9 << 12)  # lanes 0..3: 0,0,0,0x9
+        assert buf[0, 9] == 0xF          # lane 4 (sentinel hi) in slot 0
+        with pytest.raises(ValueError):
+            pack_ids20(np.full((1, 8), IDS20_SENTINEL, np.int32))
+        with pytest.raises(ValueError):
+            pack_ids20(np.zeros((1, 6), np.int32))  # width % 4 != 0
+
+    @pytest.mark.parametrize("compact", [False, "cur", "w32"])
+    def test_ids20_matches_raw_ids(self, compact):
+        """The 2.5 B/request stream must decide identically to the raw
+        i32 ids path — same outputs, same table state — on
+        duplicate-heavy traffic with padding holes."""
+        from throttlecrab_tpu.tpu.table import BucketTable
+
+        rng = np.random.RandomState(17)
+        n, B, K = 600, 32, 4
+        em = (np.arange(n, dtype=np.int64) % 7 + 1) * 250_000_000
+        tol = em * (np.arange(n, dtype=np.int64) % 5 + 2)
+        slots = np.arange(n, dtype=np.int32)
+        ids = rng.randint(0, n, (K, B)).astype(np.int32)
+        ids[0, 3] = ids[1, 8] = ids[3, 31] = -1  # padding holes
+        now = np.full(K, 1_753_000_000_000_000_000, np.int64)
+        wd = compact is False  # exact path exercises degen machinery too
+
+        from throttlecrab_tpu.tpu.kernel import pack_ids20
+
+        t1 = BucketTable(1024)
+        r1 = t1.upload_id_rows(slots, em, tol)
+        out_raw = np.asarray(
+            t1.check_many_ids(r1, ids, now, 1, with_degen=wd, compact=compact)
+        )
+        t2 = BucketTable(1024)
+        r2 = t2.upload_id_rows(slots, em, tol)
+        out_20 = np.asarray(
+            t2.check_many_ids20(
+                r2, pack_ids20(ids), now, 1, with_degen=wd, compact=compact
+            )
+        )
+        # Padding lanes are don't-care (the two paths clip them onto
+        # different rows before masking); every VALID lane must match,
+        # and the allowed bit must be off on padding in both.
+        valid = ids >= 0
+        if compact is False:
+            np.testing.assert_array_equal(
+                out_raw[:, :, :][np.broadcast_to(valid[:, None, :],
+                                                 out_raw.shape)],
+                out_20[np.broadcast_to(valid[:, None, :], out_20.shape)],
+            )
+            assert not out_raw[:, 0, :][~valid].any()
+            assert not out_20[:, 0, :][~valid].any()
+        else:
+            np.testing.assert_array_equal(out_raw[valid], out_20[valid])
+            assert not (out_raw[~valid] & 1).any()
+            assert not (out_20[~valid] & 1).any()
+        np.testing.assert_array_equal(
+            np.asarray(t1.state)[:700], np.asarray(t2.state)[:700]
+        )
+
+    def test_ids20_plain_entry_matches_acc_twin(self):
+        """The public non-accumulating gcra_scan_ids20 must decide
+        identically to the _acc twin the table routes through (same
+        pinning the other plain/acc pairs get)."""
+        from throttlecrab_tpu.tpu.kernel import (
+            EMPTY_EXPIRY,
+            gcra_scan_ids20,
+            gcra_scan_ids20_acc,
+            pack_id_rows,
+            pack_ids20,
+            pack_state,
+        )
+
+        n, B, K = 40, 16, 3
+        em = np.full(n, 400_000_000, np.int64)
+        tol = em * 5
+        rows = jnp.asarray(pack_id_rows(np.arange(n, dtype=np.int32), em, tol))
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, n, (K, B)).astype(np.int32)
+        buf = pack_ids20(ids)
+        now = np.full(K, 1_753_000_000_000_000_000, np.int64)
+
+        def fresh():
+            return pack_state(
+                jnp.zeros((256,), jnp.int64),
+                jnp.full((256,), EMPTY_EXPIRY, jnp.int64),
+            )
+
+        st1, out1 = gcra_scan_ids20(
+            fresh(), rows, jnp.asarray(buf), now, 1,
+            with_degen=False, compact="cur",
+        )
+        st2, acc, out2 = gcra_scan_ids20_acc(
+            fresh(), jnp.zeros((), jnp.int64), rows, jnp.asarray(buf),
+            now, 1, with_degen=False, compact="cur",
+        )
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        np.testing.assert_array_equal(np.asarray(st1), np.asarray(st2))
+        assert int(acc) == 0  # fresh table: no expired hits
+
+    def test_ids20_rejects_malformed_buffer(self):
+        from throttlecrab_tpu.tpu.kernel import pack_id_rows
+        from throttlecrab_tpu.tpu.table import BucketTable
+
+        t = BucketTable(64)
+        rows = t.upload_id_rows(
+            np.arange(4, dtype=np.int32),
+            np.full(4, NS, np.int64),
+            np.full(4, 2 * NS, np.int64),
+        )
+        # Raw i32 ids where the u16 stream belongs: loud, not garbage.
+        with pytest.raises(ValueError, match="pack_ids20"):
+            t.check_many_ids20(
+                rows, np.zeros((1, 5), np.int32), np.array([1], np.int64)
+            )
+        # Wrong width (not a multiple of 5 lanes).
+        with pytest.raises(ValueError, match="pack_ids20"):
+            t.check_many_ids20(
+                rows, np.zeros((1, 8), np.uint16), np.array([1], np.int64)
+            )
+
+    def test_ids20_rejects_oversized_table(self):
+        from throttlecrab_tpu.tpu.kernel import pack_id_rows, pack_ids20
+        from throttlecrab_tpu.tpu.table import BucketTable
+
+        t = BucketTable(64)
+        n = (1 << 20)  # one past the sentinel bound
+        rows = np.zeros((n, 8), np.int32)
+        with pytest.raises(ValueError, match="sentinel"):
+            t.check_many_ids20(
+                jnp.asarray(rows),
+                pack_ids20(np.zeros((1, 4), np.int32)),
+                np.array([1], np.int64),
+            )

@@ -426,6 +426,54 @@ class BucketTable:
         )
         return out
 
+    def check_many_ids20(
+        self,
+        id_rows,
+        packed,
+        now_ns,
+        quantity: int = 1,
+        with_degen: bool = True,
+        compact=False,
+        params_cur_safe: bool = False,
+    ) -> jax.Array:
+        """K stacked micro-batches of 20-bit packed key ids
+        (u16[K, B + B//4], kernel.pack_ids20): 2.5 bytes per request on
+        the wire.  Requires the resident table to stay below the
+        padding sentinel so padding can never alias a real key."""
+        from .kernel import IDS20_SENTINEL, gcra_scan_ids20_acc
+
+        if isinstance(id_rows, ResidentIdRows):
+            id_rows = id_rows.rows_checked()
+        if id_rows.shape[0] > IDS20_SENTINEL:
+            raise ValueError(
+                "20-bit id stream needs n_ids <= 2^20 - 1 (the padding "
+                f"sentinel); table has {id_rows.shape[0]} id rows"
+            )
+        # Loudly reject a sibling API's buffer (raw i32 ids would be
+        # silently truncated into in-range garbage decisions).
+        if packed.shape[1] % 5 or packed.dtype != np.uint16:
+            raise ValueError(
+                "packed must be the u16[K, B + B//4] stream from "
+                f"kernel.pack_ids20 (got {packed.dtype}"
+                f"[..., {packed.shape[1]}])"
+            )
+        assert packed.shape[1] * 4 // 5 <= self.SCRATCH
+        track_cur_safety(self, compact, params_cur_safe)
+        self.note_launch_now(_host_max_now(now_ns))
+        self.state, self.exp_acc, out = gcra_scan_ids20_acc(
+            self.state,
+            self.exp_acc,
+            id_rows,
+            packed
+            if isinstance(packed, jax.Array)
+            else jnp.asarray(packed, jnp.uint16),
+            jnp.asarray(now_ns, jnp.int64),
+            quantity,
+            with_degen=with_degen,
+            compact=compact,
+        )
+        return out
+
     def sweep(self, now_ns: int) -> np.ndarray:
         """Vacate expired slots; returns the boolean expired mask (host)."""
         self.state, expired = sweep_expired(now_ns, self.state, self.capacity)
