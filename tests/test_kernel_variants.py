@@ -499,3 +499,39 @@ def test_w32_snapshot_restore_carries_tol_hwm(tmp_path):
     ref = twin.rate_limit_batch(["k"], 10, 100, 60, 1, T + NS, wire=True)
     np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
     np.testing.assert_array_equal(res.remaining, ref.remaining)
+
+
+def test_w32_snapshot_restore_carries_writer_clock(tmp_path):
+    """A snapshot written at a LATER clock embeds the writer's now in
+    its TATs; a reader whose clock lags must not take w32 (reset would
+    overflow its field by the skew).  Restore seeds now_hwm with the
+    max restored TAT, so w32 stays off until the reader catches up."""
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+    from throttlecrab_tpu.tpu.snapshot import load_snapshot, save_snapshot
+
+    T = 1_753_700_000 * NS
+    writer = TpuRateLimiter(capacity=128)
+    twin = TpuRateLimiter(capacity=128)
+    later = T + 5000 * NS
+    for L in (writer, twin):
+        r = L.rate_limit_batch(["k"], 10, 100, 60, 1, later, wire=True)
+        assert bool(r.allowed[0])
+    path = tmp_path / "skew.npz"
+    save_snapshot(writer, path)
+
+    reader = TpuRateLimiter(capacity=128)
+    assert load_snapshot(reader, path, now_ns=later) == 1
+    assert reader.table.now_hwm >= later  # writer clock recovered
+
+    # Reader's clock lags the writer by ~5000 s: w32 must be refused
+    # and the values must match the never-snapshotted twin at the same
+    # (skewed) timestamp.
+    h = reader.dispatch_many([(["k"], 10, 100, 60, 1, T)], wire=True)
+    assert not getattr(h, "_w32", True)
+    res = h.fetch()[0]
+    ref = twin.rate_limit_batch(["k"], 10, 100, 60, 1, T, wire=True)
+    np.testing.assert_array_equal(res.allowed, ref.allowed)
+    np.testing.assert_array_equal(res.remaining, ref.remaining)
+    np.testing.assert_array_equal(res.reset_after_s, ref.reset_after_s)
+    np.testing.assert_array_equal(res.retry_after_s, ref.retry_after_s)
+    assert int(res.reset_after_s[0]) > 2047  # the skew-inflated value

@@ -41,10 +41,18 @@ from ..tpu.kernel import (
     EMPTY_EXPIRY,
     _gcra_body,
     cur_wire_safe,
+    finish_cur,
+    finish_w32,
+    fits_w32_wire,
     pack_state,
     unpack_state,
 )
-from ..tpu.table import track_cur_safety
+from ..tpu.table import (
+    HwmMarksMixin,
+    _host_max_now,
+    _host_max_tol,
+    track_cur_safety,
+)
 from ..tpu.keymap import PyKeyMap
 from ..tpu.limiter import (
     BatchResult,
@@ -85,7 +93,7 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (AXIS,))
 
 
-class ShardedBucketTable:
+class ShardedBucketTable(HwmMarksMixin):
     """Per-slot GCRA state sharded ``[D, rows, 4]`` over the mesh."""
 
     SCRATCH = 1 << 16
@@ -103,6 +111,10 @@ class ShardedBucketTable:
         # Cross-launch compact="cur" certificate, same contract as
         # BucketTable.cur_safe (tpu/table.py track_cur_safety).
         self.cur_safe = True
+        # High-water marks for the compact="w32" certificate
+        # (HwmMarksMixin, shared with BucketTable).
+        self.tol_hwm = 0
+        self.now_hwm = 0
 
     @staticmethod
     def _host_empty(d: int, rows: int):
@@ -123,7 +135,9 @@ class ShardedBucketTable:
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
-        cur = compact == "cur"
+        # cur AND w32 both emit one word per request with the allowed
+        # bit at bit 0 (the w32 field layout starts with it).
+        cur = compact in ("cur", "w32")
 
         def local(state, slots, rank, is_last, em, tol, q, valid, now):
             st, out, n_exp = _gcra_body(
@@ -196,6 +210,8 @@ class ShardedBucketTable:
         """
         assert slots.shape[1] <= self.SCRATCH
         track_cur_safety(self, compact, params_cur_safe)
+        self.note_max_tolerance(_host_max_tol(valid, tolerance))
+        self.note_launch_now(_host_max_now(now_ns))
         step = self._step(with_degen, compact)
         self.state, out, counters = step(
             self.state,
@@ -225,7 +241,7 @@ class ShardedBucketTable:
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
-        cur = compact == "cur"
+        cur = compact in ("cur", "w32")  # one word/request, allowed at bit 0
 
         def local(state, slots, rank, is_last, em, tol, q, valid, now):
             def step(st, batch):
@@ -300,6 +316,8 @@ class ShardedBucketTable:
         """
         assert slots.shape[2] <= self.SCRATCH
         track_cur_safety(self, compact, params_cur_safe)
+        self.note_max_tolerance(_host_max_tol(valid, tolerance))
+        self.note_launch_now(_host_max_now(now_ns))
         step = self._scan_step(with_degen, compact)
         self.state, out, counters = step(
             self.state,
@@ -383,10 +401,12 @@ class _PendingShardedLaunch:
     `now_list` is set iff the launch used the compact="cur" output
     (i64[D, K, B], 8 B/request off the mesh instead of 16): fetch then
     completes the exact i32 wire values per shard slice with
-    kernel.finish_cur, exactly like the single-device path."""
+    kernel.finish_cur, exactly like the single-device path.  `w32` marks
+    the 4 B/request device-packed tier (kernel.finish_w32 unpack)."""
 
     def __init__(
         self, limiter, out_dev, counters, prepared, wire, now_list=None,
+        w32=False,
     ) -> None:
         self._limiter = limiter
         self._out_dev = out_dev
@@ -394,13 +414,12 @@ class _PendingShardedLaunch:
         self._prepared = prepared
         self._wire = wire
         self._now_list = now_list
+        self._w32 = w32
 
     def fetch(self) -> list:
         out = np.asarray(self._out_dev)
         c = np.asarray(self._counters)
         self._limiter._bump_counters(int(c[0]), int(c[1]), int(c[2]))
-        if self._now_list is not None:
-            from ..tpu.kernel import finish_cur
         results = []
         for j, prep in enumerate(self._prepared):
             (n, per_shard, slots, rank, is_last, em, tol, q, vmask,
@@ -414,7 +433,13 @@ class _PendingShardedLaunch:
                 m = len(ix)
                 if m == 0:
                     continue
-                if self._now_list is not None:
+                if self._w32:
+                    al, rem, res, ret = finish_w32(out[d, j, :m])
+                    allowed[ix] = al != 0
+                    remaining[ix] = rem
+                    reset_after[ix] = res
+                    retry_after[ix] = ret
+                elif self._now_list is not None:
                     al, rem, res, ret = finish_cur(
                         out[d, j, :m], emission[ix], tolerance[ix],
                         quantity[ix], self._now_list[j],
@@ -634,15 +659,26 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
         B = slots.shape[1]
         degen = has_degenerate(valid, emission, tolerance, quantity)
         with_degen = not wire or degen
-        # 8 B/request "cur" output when the certified fast path and the
-        # valid-masked cur bound hold (host-finished, same wire values);
-        # table.cur_safe carries the certificate across launches.
+        # Compact output ladder off the mesh, same tiers as the
+        # single-device dispatch: w32 (4 B/request, device-packed) →
+        # cur (8 B, host-finished) → 4-plane i32; the table's hwm /
+        # cur_safe marks carry the certificates across launches.
         params_cur_safe = cur_wire_safe(valid, tolerance, now_ns)
-        use_cur = (
-            wire and not degen and params_cur_safe and self.table.cur_safe
+        use_w32 = (
+            wire
+            and not degen
+            and fits_w32_wire(
+                valid, emission, tolerance, quantity, now_ns,
+                self.table.tol_hwm, self.table.now_hwm,
+            )
         )
-        if use_cur:
-            from ..tpu.kernel import finish_cur
+        use_cur = (
+            not use_w32
+            and wire
+            and not degen
+            and params_cur_safe
+            and self.table.cur_safe
+        )
 
         allowed = np.zeros(n, bool)
         remaining = np.zeros(n, np.int64)
@@ -664,7 +700,7 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
             out_dev, counters = self.table.check_batch(
                 slots, rk, il, em, tol, q, rmask, now_ns,
                 with_degen=with_degen,
-                compact="cur" if use_cur else wire,
+                compact="w32" if use_w32 else ("cur" if use_cur else wire),
                 params_cur_safe=params_cur_safe,
             )
             out = np.asarray(out_dev)
@@ -676,7 +712,13 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
                     continue
                 sel = rmask[d, :m]
                 dst = ix[sel]
-                if use_cur:
+                if use_w32:
+                    al, rem, res, ret = finish_w32(out[d, :m][sel])
+                    allowed[dst] = al != 0
+                    remaining[dst] = rem
+                    reset_after[dst] = res
+                    retry_after[dst] = ret
+                elif use_cur:
                     al, rem, res, ret = finish_cur(
                         out[d, :m][sel], emission[dst], tolerance[dst],
                         quantity[dst], now_ns,
@@ -772,15 +814,24 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
             valid_s[:, j, :Bj] = vmask
             now_s[j] = batches[j][5]
 
-        # 8 B/request "cur" output off the mesh when the certified fast
-        # path and the valid-masked cur bound hold (same rule as the
-        # single-device dispatch paths); host-finished in fetch().
-        # table.cur_safe carries the certificate across launches.
-        params_cur_safe = cur_wire_safe(
-            valid_s, tol_s, int(now_s.max(initial=0))
+        # Compact output ladder off the mesh (w32 → cur → 4-plane),
+        # same certificates as the single-device dispatch paths;
+        # host-finished in fetch().
+        now_max = int(now_s.max(initial=0))
+        params_cur_safe = cur_wire_safe(valid_s, tol_s, now_max)
+        use_w32 = (
+            wire
+            and not any_degen
+            and now_max < (1 << 61)
+            and bool((np.diff(now_s) >= 0).all())
+            and fits_w32_wire(
+                valid_s, em_s, tol_s, q_s, int(now_s[0]),
+                self.table.tol_hwm, self.table.now_hwm,
+            )
         )
         use_cur = (
-            wire
+            not use_w32
+            and wire
             and not any_degen
             and params_cur_safe
             and self.table.cur_safe
@@ -788,12 +839,13 @@ class ShardedTpuRateLimiter(ScalarCompatMixin):
         out_dev, counters = self.table.check_many(
             slots_s, rank_s, last_s, em_s, tol_s, q_s, valid_s, now_s,
             with_degen=not wire or any_degen,
-            compact="cur" if use_cur else wire,
+            compact="w32" if use_w32 else ("cur" if use_cur else wire),
             params_cur_safe=params_cur_safe,
         )
         return _PendingShardedLaunch(
             self, out_dev, counters, prepared, wire,
             now_list=[int(b[5]) for b in batches] if use_cur else None,
+            w32=use_w32,
         )
 
     # ------------------------------------------------------------------ #

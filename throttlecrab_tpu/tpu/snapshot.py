@@ -266,6 +266,16 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
             (e - t for t, e in zip(tats, expiries)), default=0
         )
         note(restored_tol if restored_tol < (1 << 62) else None)
+    # The restored TATs also embed the WRITER's clock: tat <= writer_now
+    # + tol, and a reader whose clock lags the writer would pass the w32
+    # certificate while reset/retry overflow their fields.  Seeding
+    # now_hwm with the max restored TAT restores the invariant
+    # stored <= now_hwm + tol_hwm outright (tat <= max_tat), so w32
+    # stays off exactly until the reader's clock catches up.
+    note_now = getattr(limiter.table, "note_launch_now", None)
+    if note_now is not None:
+        restored_tat = max(tats, default=0)
+        note_now(restored_tat if restored_tat < (1 << 62) else None)
 
     if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
         import jax

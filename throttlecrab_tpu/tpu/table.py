@@ -128,7 +128,30 @@ class ResidentIdRows:
         return self.rows
 
 
-class BucketTable:
+class HwmMarksMixin:
+    """The compact="w32" certificate's cross-launch high-water marks,
+    shared by BucketTable and ShardedBucketTable: every stored TAT is
+    <= its writing launch's now + tol <= now_hwm + tol_hwm, which
+    fits_w32_wire needs to bound reset/retry fields.  A launch that
+    cannot report a value saturates its mark (w32 off until rebuild).
+    Subclass __init__ sets `tol_hwm = now_hwm = 0`."""
+
+    def note_max_tolerance(self, max_tol) -> None:
+        """Record a launch's max valid-lane tolerance (None = unknown)."""
+        if max_tol is None:
+            self.tol_hwm = I64_MAX
+        else:
+            self.tol_hwm = max(self.tol_hwm, int(max_tol))
+
+    def note_launch_now(self, now_ns) -> None:
+        """Record a launch's max timestamp (None = unknown)."""
+        if now_ns is None:
+            self.now_hwm = I64_MAX
+        else:
+            self.now_hwm = max(self.now_hwm, int(now_ns))
+
+
+class BucketTable(HwmMarksMixin):
     """Per-slot GCRA state on a single device."""
 
     SCRATCH = 1 << 16  # max batch size; scratch rows for suppressed writes
@@ -161,22 +184,6 @@ class BucketTable:
         # Launches that cannot report their values saturate the marks.
         self.tol_hwm = 0
         self.now_hwm = 0
-
-    def note_max_tolerance(self, max_tol) -> None:
-        """Record a launch's max valid-lane tolerance (None = unknown:
-        saturates the mark, disabling w32 until the table is rebuilt)."""
-        if max_tol is None:
-            self.tol_hwm = I64_MAX
-        else:
-            self.tol_hwm = max(self.tol_hwm, int(max_tol))
-
-    def note_launch_now(self, now_ns) -> None:
-        """Record a launch's max timestamp (None = unknown: saturates,
-        disabling w32 — `now >= now_hwm` can then never hold)."""
-        if now_ns is None:
-            self.now_hwm = I64_MAX
-        else:
-            self.now_hwm = max(self.now_hwm, int(now_ns))
 
     def expired_hits(self) -> int:
         """Total expired-hit count since construction.  One scalar
