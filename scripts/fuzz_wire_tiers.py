@@ -1,0 +1,294 @@
+"""Round-5 differential fuzz campaign: the compact output-tier ladder
+(w32 / cur / 4-plane) vs the scalar oracle, across every dispatch path.
+
+Round 4's 1.5 M-request campaign targeted the batch/scan/wire/sharded
+APIs; this one aims at what round 5 added — the w32 certificate's edges
+and its cross-launch high-water marks:
+
+  - params straddling the w32 field bounds (burst near 500-2100,
+    tolerance near the 2047 s reset budget, retry near 1023 s);
+  - big-tolerance keys that bump tol_hwm mid-stream and force later
+    small-tol traffic down a tier;
+  - tol >= 2^61 poison keys (cur_safe) mixed into the same stream;
+  - degenerate probes (quantity 0), invalid lanes, duplicate segments,
+    per-key param churn;
+  - clock regressions (now stepping backward — the now_hwm guard);
+  - mid-stream sweeps and snapshot save/restore (hwm recovery from
+    restored TATs);
+
+against single-device dispatch_many (native + python keymaps),
+dispatch_wire_window (native prep + agg certificate), and the sharded
+mesh dispatcher — all compared request-by-request to the scalar oracle
+with the documented wire truncation (seconds, i32 saturation).
+
+Usage: python scripts/fuzz_wire_tiers.py [--seeds N] [--steps M]
+Exit 0 and a one-line tally on success; raises on first divergence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+from throttlecrab_tpu.core.errors import CellError
+from throttlecrab_tpu.core.rate_limiter import RateLimiter
+from throttlecrab_tpu.core.store.periodic import PeriodicStore
+
+NS = 1_000_000_000
+T0 = 1_753_700_000 * NS
+I32_MAX = (1 << 31) - 1
+
+TOTAL = {"requests": 0, "windows": 0, "tiers": {"w32": 0, "cur": 0, "planes": 0}}
+
+
+def draw_params(rng, profile):
+    """One key's (burst, count, period).
+
+    `profile` shapes the seed's traffic: "benign" stays inside the w32
+    certificate (so whole streams ride the 4 B tier and its cross-launch
+    bookkeeping), "edges" hugs the field bounds, "hostile" mixes in
+    cur-only, poison (tol >= 2^61) and degenerate keys so the ladder
+    keeps stepping down mid-stream.
+    """
+    r = rng.random()
+    if profile == "benign":
+        # em <= 1 s (count >= period) and burst <= 400 keeps tol within
+        # ~400 s — comfortably inside every w32 field bound.
+        period = int(rng.integers(1, 600))
+        count = period * int(rng.integers(1, 120))
+        return (int(rng.integers(2, 400)), count, period)
+    if profile == "edges":
+        if r < 0.6:
+            # em = 1 s exactly; burst sweeps across the w32 reset
+            # boundary (tol ~ 1024 s is where tol + hwm crosses 2047).
+            period = int(rng.integers(1, 120))
+            return (int(rng.integers(400, 2300)), period, period)
+        period = int(rng.integers(1, 600))
+        count = period * int(rng.integers(1, 120))
+        return (int(rng.integers(2, 400)), count, period)
+    # hostile
+    if r < 0.25:
+        return (int(rng.integers(2, 200)), int(rng.integers(1, 1000)),
+                int(rng.integers(1, 600)))
+    if r < 0.45:   # cur tier only (reset far past 2047 s)
+        return (int(rng.integers(3000, 100_000)), 60, 60)
+    if r < 0.58:   # tol >= 2^61 poison (4-plane + sticky cur_safe)
+        return (3_000_000_000, 1, 1)
+    if r < 0.72:   # degen material: burst 1 (tol 0)
+        return (1, int(rng.integers(1, 50)), int(rng.integers(1, 60)))
+    return (int(rng.integers(2, 50)), int(rng.integers(1, 3000)),
+            int(rng.choice([1, 10, 60, 3600])))
+
+
+def oracle_wire(oracle, keys, burst, count, period, qty, now_ns):
+    n = len(keys)
+    out = {
+        "allowed": np.zeros(n, bool),
+        "remaining": np.zeros(n, np.int64),
+        "reset_s": np.zeros(n, np.int64),
+        "retry_s": np.zeros(n, np.int64),
+        "bad": np.zeros(n, bool),
+    }
+    for i in range(n):
+        try:
+            a, r = oracle.rate_limit(
+                keys[i] if isinstance(keys[i], str) else keys[i].decode(),
+                int(burst[i]), int(count[i]), int(period[i]), int(qty[i]),
+                now_ns,
+            )
+        except CellError:
+            out["bad"][i] = True
+            continue
+        out["allowed"][i] = a
+        out["remaining"][i] = min(r.remaining, I32_MAX)
+        out["reset_s"][i] = min(r.reset_after_ns // NS, I32_MAX)
+        out["retry_s"][i] = min(r.retry_after_ns // NS, I32_MAX)
+    return out
+
+
+def check(res, want, ctx):
+    ok = ~want["bad"]
+    if not (np.asarray(res.status)[ok] == 0).all():
+        raise AssertionError(f"{ctx}: unexpected status on valid lanes")
+    for name, got in (
+        ("allowed", np.asarray(res.allowed)),
+        ("remaining", np.asarray(res.remaining)),
+        ("reset_s", np.asarray(res.reset_after_s)),
+        ("retry_s", np.asarray(res.retry_after_s)),
+    ):
+        g, w = got[ok], want[name][ok]
+        if not (g == w).all():
+            i = int(np.nonzero(g != w)[0][0])
+            raise AssertionError(
+                f"{ctx}: {name} diverged at valid lane {i}: "
+                f"got {g[i]} want {w[i]}"
+            )
+
+
+def tier_of(handle):
+    if getattr(handle, "_w32", False):
+        return "w32"
+    if getattr(handle, "_cur", False) or getattr(handle, "_now_list", None):
+        return "cur"
+    return "planes"
+
+
+def run_seed(seed, steps, sharded_mesh):
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+    from throttlecrab_tpu.tpu.snapshot import load_snapshot, save_snapshot
+
+    rng = np.random.default_rng(seed)
+    native = bool(seed % 2)
+    try:
+        lim = TpuRateLimiter(
+            capacity=512, keymap="native" if native else "python"
+        )
+    except RuntimeError:
+        lim = TpuRateLimiter(capacity=512)
+        native = False
+    if sharded_mesh is not None:
+        from throttlecrab_tpu.parallel.sharded import ShardedTpuRateLimiter
+
+        shl = ShardedTpuRateLimiter(
+            capacity_per_shard=256, mesh=sharded_mesh
+        )
+    else:
+        shl = None
+    oracle = RateLimiter(PeriodicStore())
+    oracle_sh = RateLimiter(PeriodicStore())
+
+    profile = ("benign", "edges", "hostile")[seed % 3]
+    pool = [f"z{seed}x{i}" for i in range(int(rng.integers(4, 14)))]
+    params = {k: draw_params(rng, profile) for k in pool}
+    now = T0
+    # Clock regressions must never cross a sweep or snapshot-restore
+    # point: both drop entries expired AS OF that moment (exactly like
+    # the reference's retain-based cleanup), while the bare-store
+    # oracle expires on read and would "resurrect" them at an earlier
+    # timestamp.  The engine is right; the comparison must respect the
+    # drop point.
+    floor_now = 0
+    for step in range(steps):
+        # Occasional param churn, sweeps, clock moves (incl. regression).
+        if rng.random() < 0.15:
+            k = pool[rng.integers(len(pool))]
+            params[k] = draw_params(rng, profile)
+        if rng.random() < 0.12:
+            jump = int(rng.integers(1, 7200)) * NS
+            now += jump
+            lim.sweep(now)
+            if shl is not None:
+                shl.sweep(now)
+            floor_now = now
+        # The oracle expires on read; only engines need explicit sweeps.
+        n = int(rng.integers(2, 28))
+        ks = [pool[rng.integers(len(pool))] for _ in range(n)]
+        b = np.array([params[k][0] for k in ks], np.int64)
+        c = np.array([params[k][1] for k in ks], np.int64)
+        p = np.array([params[k][2] for k in ks], np.int64)
+        # Quantity-0 probes appear in bursts on hostile streams only
+        # (a single probe anywhere in a window forfeits the fast tiers).
+        probe_p = 0.10 if profile == "hostile" else 0.0
+        q = np.array(
+            [0 if rng.random() < probe_p else 1 for _ in ks], np.int64
+        )
+        # windows of 1-3 batches through dispatch_many; each batch may
+        # move the clock forward a little, or REGRESS it (now_hwm).
+        batches = []
+        wnow = now
+        for _ in range(int(rng.integers(1, 4))):
+            if rng.random() < 0.1:
+                wnow = max(floor_now, wnow - int(rng.integers(1, 3 * NS)))
+            batches.append((ks, b, c, p, q, wnow))
+            wnow += int(rng.integers(0, NS))
+        h = lim.dispatch_many(batches, wire=True)
+        TOTAL["tiers"][tier_of(h)] += 1
+        got = h.fetch()
+        for bt, g in zip(batches, got):
+            want = oracle_wire(oracle, *bt)
+            check(g, want, f"seed{seed} step{step} single")
+            TOTAL["requests"] += len(bt[0])
+        TOTAL["windows"] += 1
+
+        if shl is not None:
+            h2 = shl.dispatch_many(batches, wire=True)
+            TOTAL["tiers"][tier_of(h2)] += 1
+            got2 = h2.fetch()
+            for bt, g in zip(batches, got2):
+                want = oracle_wire(oracle_sh, *bt)
+                check(g, want, f"seed{seed} step{step} sharded")
+                TOTAL["requests"] += len(bt[0])
+            TOTAL["windows"] += 1
+        now = wnow
+
+        # Native wire window (agg certificate) every few steps.
+        if native and step % 3 == 0 and hasattr(lim.keymap, "prepare_batch"):
+            ks2 = [k.encode() for k in ks]
+            blob = b"".join(ks2)
+            offs = np.cumsum([0] + [len(k) for k in ks2]).astype(np.int64)
+            pr = np.stack([b, c, p, q], axis=1)
+            hw = lim.dispatch_wire_window([(blob, offs, pr)], now)
+            if hw is not None:
+                res = hw.fetch()[0]
+                want = oracle_wire(oracle, ks, b, c, p, q, now)
+                check(res, want, f"seed{seed} step{step} native-wire")
+                TOTAL["requests"] += len(ks)
+                TOTAL["windows"] += 1
+            now += int(rng.integers(0, NS))
+
+        # Mid-stream snapshot round trip (hwm recovery) occasionally.
+        if step == steps // 2 and rng.random() < 0.5:
+            with tempfile.TemporaryDirectory() as d:
+                path = os.path.join(d, "fz")
+                save_snapshot(lim, path)
+                lim2 = TpuRateLimiter(
+                    capacity=512,
+                    keymap="native" if native else "python",
+                )
+                load_snapshot(lim2, path + ".npz", now_ns=now)
+                lim = lim2
+                floor_now = now
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=24)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--no-sharded", action="store_true")
+    args = ap.parse_args()
+
+    mesh = None
+    if not args.no_sharded:
+        from throttlecrab_tpu.parallel.sharded import make_mesh
+
+        try:
+            mesh = make_mesh(2)
+        except ValueError:
+            mesh = None
+    for s in range(args.seeds):
+        run_seed(3000 + s, args.steps, mesh)
+        print(
+            f"seed {3000 + s} ok — {TOTAL['requests']} requests, "
+            f"tiers {TOTAL['tiers']}",
+            file=sys.stderr, flush=True,
+        )
+    print(
+        f"PASS: {TOTAL['requests']} differential requests over "
+        f"{TOTAL['windows']} windows; tier mix {TOTAL['tiers']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
