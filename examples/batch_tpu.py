@@ -6,6 +6,14 @@ Runs on whatever backend JAX provides (TPU if available, CPU otherwise).
 import os.path as _p, sys as _s
 _s.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))
 
+if "--cpu" in _s.argv:
+    # In-process pin: the JAX_PLATFORMS env var alone is not honored
+    # once an accelerator PJRT plugin registered via sitecustomize, and
+    # a first device touch on a wedged serving tunnel hangs forever.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 import time
 
 import numpy as np

@@ -10,11 +10,24 @@ duplicate-segment structure itself) and 8 bytes down (one i64
 tk_finish_raw).  On a link-bound accelerator that is the difference
 between 0.36 and 5+ million decisions/s.
 
+The round-5 tiers shrink both directions further when their
+certificates hold — 20-bit packed ids (2.5 B/request up, tables under
+2^20 − 1 keys) and the `w32` output (4 B/request down, the device
+packs the exact wire values) — shown at the end.
+
 Runs on whatever backend JAX provides (TPU if available, CPU otherwise).
 """
 
 import os.path as _p, sys as _s
 _s.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))
+
+if "--cpu" in _s.argv:
+    # In-process pin: the JAX_PLATFORMS env var alone is not honored
+    # once an accelerator PJRT plugin registered via sitecustomize, and
+    # a first device touch on a wedged serving tunnel hangs forever.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import time
 
@@ -71,6 +84,34 @@ def main() -> None:
         f"hot key: {int(wire[:, 0].sum())}/64 allowed "
         f"(burst {int(burst[7])}, minus any tokens the random batch "
         f"above already spent on id 7)"
+    )
+
+    # ---- round-5 minimum: 2.5 B up, 4 B down ------------------------
+    # 20-bit packed ids + the w32 device-packed wire word.  fits_w32_wire
+    # certifies this key universe (small tolerances), so the unpack is
+    # three shifts — no reconstruction arithmetic at all.
+    from throttlecrab_tpu.tpu.kernel import (
+        finish_w32,
+        fits_w32_wire,
+        pack_ids20,
+    )
+
+    assert fits_w32_wire(
+        np.ones(n_keys, bool), em, tol, np.ones(n_keys, np.int64),
+        now, table.tol_hwm, table.now_hwm,
+    )
+    ids2 = rng.integers(0, n_keys, 4096).astype(np.int32)
+    w = np.asarray(
+        table.check_many_ids20(
+            rows, pack_ids20(ids2.reshape(1, 4096)),
+            np.array([now + 1_000_000], np.int64),
+            quantity=1, with_degen=False, compact="w32",
+        )
+    ).reshape(-1)
+    allowed, remaining, reset_s, retry_s = finish_w32(w)
+    print(
+        f"ids20+w32 (6.5 B/request): {int(allowed.sum())} allowed; "
+        f"reset_s[0..4] = {reset_s[:4].tolist()}"
     )
 
 

@@ -1,16 +1,22 @@
 """Mesh-sharded engine: key-shard data parallelism over all devices.
 
 Run CPU-hermetic with:
-  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-      python examples/sharded_mesh.py
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/sharded_mesh.py --cpu
 """
 
 import os.path as _p, sys as _s
 _s.path.insert(0, _p.dirname(_p.dirname(_p.abspath(__file__))))
 
-import time
-
 import jax
+
+if "--cpu" in _s.argv:
+    # In-process pin: the JAX_PLATFORMS env var alone is not honored
+    # once an accelerator PJRT plugin registered via sitecustomize, and
+    # a first device touch on a wedged serving tunnel hangs forever.
+    jax.config.update("jax_platforms", "cpu")
+
+import time
 
 from throttlecrab_tpu.parallel import ShardedTpuRateLimiter
 from throttlecrab_tpu.parallel.sharded import make_mesh
