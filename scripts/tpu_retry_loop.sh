@@ -1,0 +1,39 @@
+#!/bin/bash
+# Patient TPU-recovery loop for a wedged axon relay.
+#
+# The relay can stay wedged for hours-to-rounds (docs/
+# tpu-launch-profile.md "Operational hazard"); in round 5 every claim
+# attempt either hung silently or failed after ~25 min with
+# "UNAVAILABLE: TPU backend setup/compile error".  This loop keeps a
+# claim attempt in flight (never timeout-killed — killing a mid-claim
+# process is what poisons the relay) and, on the FIRST healthy claim,
+# immediately captures the round's hardware evidence in priority order:
+#
+#   1. scripts/probe_sharded_1dev.py  — the round-4 known-issue repro
+#      (TESTING.md), highest-value single artifact;
+#   2. python bench.py               — the headline number (auto-selects
+#      the ids20 + w32 minimum-wire tiers on TPU);
+#   3. python bench.py --wire cur    — the A/B that isolates the w32
+#      fetch halving.
+#
+# Run it detached:  nohup scripts/tpu_retry_loop.sh &
+# Poll:             tail -f /tmp/tpu_retry.log
+cd "$(dirname "$0")/.." || exit 1
+LOG=${TPU_RETRY_LOG:-/tmp/tpu_retry.log}
+for i in $(seq 1 200); do
+  echo "=== attempt $i $(date +%H:%M:%S)" >> "$LOG"
+  python scripts/tpu_wait_probe.py >> "$LOG" 2>&1
+  rc=$?
+  echo "=== attempt $i rc=$rc" >> "$LOG"
+  if [ $rc -eq 0 ]; then
+    echo "=== TUNNEL HEALTHY, capturing evidence" >> "$LOG"
+    python scripts/probe_sharded_1dev.py > /tmp/probe_sharded_tpu.log 2>&1
+    echo "=== probe_sharded rc=$?" >> "$LOG"
+    python bench.py > /tmp/bench_tpu_r5.log 2>&1
+    echo "=== bench rc=$?" >> "$LOG"
+    python bench.py --wire cur --no-resident > /tmp/bench_tpu_r5_cur.log 2>&1
+    echo "=== bench(cur A/B) rc=$? DONE" >> "$LOG"
+    exit 0
+  fi
+  sleep 150
+done
