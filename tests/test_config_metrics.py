@@ -113,6 +113,7 @@ def test_prometheus_export_names():
         "throttlecrab_requests_errors",
         "throttlecrab_top_denied_keys",
         "throttlecrab_tpu_device_launches",
+        "throttlecrab_tpu_expired_hits",
     ):
         assert name in text, name
     assert 'throttlecrab_top_denied_keys{key="bad-key",rank="1"} 1' in text

@@ -273,9 +273,10 @@ def test_adaptive_expired_ratio_fires_engine_sweep():
         clock = VirtualClock()
         policy = AdaptivePolicy()
         limiter = TpuRateLimiter(capacity=1024)
+        metrics = Metrics()
         engine = BatchingEngine(
             limiter, batch_size=128, max_linger_us=500,
-            cleanup_policy=policy, now_fn=clock,
+            cleanup_policy=policy, now_fn=clock, metrics=metrics,
         )
         # 120 keys with ~1 s TTLs.
         await asyncio.gather(*[
@@ -296,12 +297,15 @@ def test_adaptive_expired_ratio_fires_engine_sweep():
         clock.now += int(1.2 * NS)
         await engine.throttle(req(key="tick"))
         await asyncio.sleep(0.05)  # let the executor sweep land
-        return limiter, policy
+        return limiter, policy, metrics
 
-    limiter, policy = run(main())
+    limiter, policy, metrics = run(main())
     # The sweep collected the 60 still-expired entries (the revisited 60
     # were refreshed by their hits, exactly like the reference's
     # set_if_not_exists re-insert) and reset the policy's hit count.
     assert policy._last_total > 0  # after_sweep ran
     assert policy._expired == 0
     assert len(limiter) <= 62  # 120 + tick - 60 swept (y may survive)
+    # The drained count is mirrored into /metrics.
+    assert metrics.expired_hits == 60
+    assert "throttlecrab_tpu_expired_hits 60" in metrics.export_prometheus()

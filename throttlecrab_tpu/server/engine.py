@@ -411,40 +411,51 @@ class BatchingEngine:
                 is not None
                 and self.limiter.expired_hits_fetch_due(now_ns)
             )
+            n_hits = 0
             if not fetch_due:
-                feed_expired_hits(policy, self.limiter, now_ns)
+                n_hits = feed_expired_hits(policy, self.limiter, now_ns)
             live = len(self.limiter)
             capacity = getattr(self.limiter, "total_capacity", 1 << 62)
             should = fetch_due or policy.should_clean(now_ns, live, capacity)
+        if n_hits and self.metrics is not None:
+            self.metrics.record_expired_hits(n_hits)
         if should:
             loop = asyncio.get_running_loop()
 
             def locked_policy_step():
+                drained = 0
                 with self.limiter_lock:
                     live_now = live
                     if fetch_due:
-                        feed_expired_hits(policy, self.limiter, now_ns)
+                        drained += feed_expired_hits(
+                            policy, self.limiter, now_ns
+                        )
                         live_now = len(self.limiter)
                         if not policy.should_clean(
                             now_ns, live_now, capacity
                         ):
-                            return None
+                            return None, drained
                     # Attribute hits already counted on-device to the
                     # window this sweep closes (after_sweep resets the
                     # policy's count — a late drain would leak them into
                     # the fresh window).  Redundant when fetch_due: the
                     # drain above just ran under this same lock hold.
                     if not fetch_due:
-                        feed_expired_hits(
+                        drained += feed_expired_hits(
                             policy, self.limiter, now_ns, force=True
                         )
                     freed = self.limiter.sweep(now_ns)
                     policy.after_sweep(now_ns, freed, live_now)
-                    return freed
+                    return freed, drained
 
-            freed = await loop.run_in_executor(None, locked_policy_step)
-            if freed is not None and self.metrics is not None:
-                self.metrics.record_sweep(freed)
+            freed, drained = await loop.run_in_executor(
+                None, locked_policy_step
+            )
+            if self.metrics is not None:
+                if drained:
+                    self.metrics.record_expired_hits(drained)
+                if freed is not None:
+                    self.metrics.record_sweep(freed)
 
     async def shutdown(self) -> None:
         """Flush outstanding requests and refuse new ones."""

@@ -85,6 +85,7 @@ class Metrics:
         self.max_batch = 0
         self.sweeps = 0
         self.slots_freed = 0
+        self.expired_hits = 0
 
     @classmethod
     def builder(cls) -> "MetricsBuilder":
@@ -146,6 +147,12 @@ class Metrics:
     def record_sweep(self, freed: int) -> None:
         self.sweeps += 1
         self.slots_freed += freed
+
+    def record_expired_hits(self, n: int) -> None:
+        """Requests that landed on expired entries (the kernel's
+        device-side count, drained via the cleanup policy path)."""
+        with self._lock:
+            self.expired_hits += n
 
     def set_cluster_stats_provider(self, provider) -> None:
         """`provider()` -> {peer_addr: {"forwarded": n, "failed": n}};
@@ -242,6 +249,13 @@ class Metrics:
             "Expiry compaction sweeps executed",
             "counter",
             self.sweeps,
+        )
+        metric(
+            "throttlecrab_tpu_expired_hits",
+            "Requests that landed on expired entries "
+            "(kernel-counted; drives the adaptive cleanup trigger)",
+            "counter",
+            self.expired_hits,
         )
         metric(
             "throttlecrab_tpu_slots_freed",

@@ -356,25 +356,33 @@ class NativeRedisTransport:
             return
         from ..tpu.cleanup import feed_expired_hits
 
+        n_hits = 0
         with self.limiter_lock:
             policy.record_ops(n_ops)
             # Did the throttled drain just hit the device?  Then the
             # pre-sweep force drain below would be a redundant second
             # blocking fetch (same lock hold, nothing launched between).
-            drained = getattr(
+            fetched = getattr(
                 self.limiter, "expired_hits_fetch_due", lambda t: False
             )(now_ns)
-            feed_expired_hits(policy, self.limiter, now_ns)
+            n_hits += feed_expired_hits(policy, self.limiter, now_ns)
             live = len(self.limiter)
             capacity = getattr(self.limiter, "total_capacity", 1 << 62)
             if not policy.should_clean(now_ns, live, capacity):
-                return
-            # Attribute on-device hits to the window this sweep closes
-            # (see engine._maybe_sweep); this driver thread already
-            # sweeps inline, so the blocking fetch is acceptable here.
-            if not drained:
-                feed_expired_hits(policy, self.limiter, now_ns, force=True)
-            freed = self.limiter.sweep(now_ns)
-            policy.after_sweep(now_ns, freed, live)
+                freed = None
+            else:
+                # Attribute on-device hits to the window this sweep
+                # closes (see engine._maybe_sweep); this driver thread
+                # already sweeps inline, so the blocking fetch is
+                # acceptable here.
+                if not fetched:
+                    n_hits += feed_expired_hits(
+                        policy, self.limiter, now_ns, force=True
+                    )
+                freed = self.limiter.sweep(now_ns)
+                policy.after_sweep(now_ns, freed, live)
         if self.metrics is not None:
-            self.metrics.record_sweep(freed)
+            if n_hits:
+                self.metrics.record_expired_hits(n_hits)
+            if freed is not None:
+                self.metrics.record_sweep(freed)

@@ -189,10 +189,12 @@ class AdaptivePolicy(CleanupPolicy):
         self._expired = 0
 
 
-def feed_expired_hits(policy, limiter, now_ns: int, force: bool = False) -> None:
+def feed_expired_hits(policy, limiter, now_ns: int, force: bool = False) -> int:
     """Drain the limiter's expired-hit counter into a policy that wants
-    it.  Shared by every transport's sweep hook (engine._maybe_sweep and
-    the native driver's); call under limiter_lock.
+    it; returns the drained count (0 when throttled or inapplicable) so
+    callers can mirror it into metrics.  Shared by every transport's
+    sweep hook (engine._maybe_sweep and the native driver's); call
+    under limiter_lock.
 
     `force=True` bypasses the fetch throttle — used just before a sweep
     so hits counted on-device are attributed to the pre-sweep window
@@ -200,13 +202,14 @@ def feed_expired_hits(policy, limiter, now_ns: int, force: bool = False) -> None
     them into the fresh window and could fire a redundant ratio sweep).
     """
     if not getattr(policy, "uses_expired_signal", False):
-        return
+        return 0
     take = getattr(limiter, "take_expired_hits", None)
     if take is None:
-        return
+        return 0
     n = take(now_ns, 0) if force else take(now_ns)
     if n:
         policy.record_expired(n)
+    return n
 
 
 def make_policy(name: str, **kwargs) -> CleanupPolicy:
