@@ -1,0 +1,39 @@
+"""Always-on slice of the round-5 tier-ladder fuzz campaign.
+
+scripts/fuzz_wire_tiers.py is the full campaign (hundreds of seeds);
+this keeps a few seeds — one per traffic profile — running in the
+regular suite so the differential class (w32/cur/4-plane tier
+selection, hwm crossings, poison keys, degenerate probes, clock
+regressions, sweeps, snapshot round trips vs the scalar oracle) can
+never silently rot.
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "fuzz_wire_tiers",
+    pathlib.Path(__file__).resolve().parent.parent
+    / "scripts"
+    / "fuzz_wire_tiers.py",
+)
+fuzz = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(fuzz)
+
+
+@pytest.mark.parametrize("seed", [3000, 3001, 3002])  # benign/edges/hostile
+def test_tier_ladder_fuzz_slice(seed):
+    from conftest import require_devices
+
+    try:
+        require_devices(2)
+        from throttlecrab_tpu.parallel.sharded import make_mesh
+
+        mesh = make_mesh(2)
+    except Exception:
+        mesh = None
+    before = fuzz.TOTAL["requests"]
+    fuzz.run_seed(seed, steps=8, sharded_mesh=mesh)
+    assert fuzz.TOTAL["requests"] > before
