@@ -1058,6 +1058,12 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                         static_cast<long long>(results[5 * i + 3]),
                         static_cast<long long>(results[5 * i + 4]));
                     body.assign(buf, len);
+                } else if (status[i] == 4) {
+                    // Shed by the front tier's admission control: 503,
+                    // the HTTP overload status (clients must be able to
+                    // tell "back off" from "server bug").
+                    code = 503;
+                    body = "{\"error\": \"server overloaded\"}";
                 } else {
                     code = 500;  // engine-level error (http.rs:148-157)
                     body = status[i] == 1
@@ -1068,8 +1074,9 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                                  "parameters\"}"
                                : "{\"error\": \"internal error\"}";
                 }
-                const char* reason =
-                    code == 200 ? "OK" : "Internal Server Error";
+                const char* reason = code == 200   ? "OK"
+                                     : code == 503 ? "Service Unavailable"
+                                                   : "Internal Server Error";
                 char head[224];
                 int hn = snprintf(
                     head, sizeof(head),
@@ -1094,6 +1101,8 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                 payload = "-ERR quantity cannot be negative\r\n";
             } else if (status[i] == 2) {
                 payload = "-ERR invalid rate limit parameters\r\n";
+            } else if (status[i] == 4) {
+                payload = "-ERR server overloaded\r\n";
             } else {
                 payload = "-ERR internal error\r\n";
             }
@@ -1106,6 +1115,14 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
     uint64_t one = 1;
     ssize_t r = write(s->wake_fd, &one, sizeof(one));
     (void)r;
+}
+
+// Requests parsed and queued but not yet popped by the driver — the
+// wire-layer queue depth the front tier's admission control keys on.
+int64_t ws_queue_depth(void* h) {
+    auto* s = static_cast<WireServer*>(h);
+    std::lock_guard<std::mutex> lk(s->q_mu);
+    return static_cast<int64_t>(s->queue.size());
 }
 
 void ws_stats(void* h, uint64_t* out_conns, uint64_t* out_requests,

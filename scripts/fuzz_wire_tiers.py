@@ -261,6 +261,90 @@ def run_seed(seed, steps, sharded_mesh):
                 floor_now = now
 
 
+def run_hotkey_deny_seed(seed, steps):
+    """Hot-key abuse traffic (harness workload `hotkey-abuse`) through
+    the front tier's deny cache: every per-request decision — status,
+    allowed, limit, remaining, reset, retry — must be identical with the
+    cache on and off, across param churn, clock jumps and sweeps.  The
+    cache must also actually serve (hits > 0), or the equality is
+    vacuous.  Returns the deny-cache hit count."""
+    import asyncio
+
+    from throttlecrab_tpu.front import DenyCache, FrontTier
+    from throttlecrab_tpu.harness.workload import make_keys
+    from throttlecrab_tpu.server.engine import BatchingEngine
+    from throttlecrab_tpu.server.types import ThrottleRequest
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    rng = np.random.default_rng(seed)
+    clock = {"now": T0}
+    window = 24
+    keys = make_keys("hotkey-abuse", steps * window, 2000, seed=seed)
+    # Tight limits with slow emission so the hot keys saturate fast and
+    # stay denied across windows — the deny cache's serving regime.
+    key_params = {
+        k: (int(rng.integers(2, 6)), int(rng.integers(1, 5)),
+            int(rng.integers(10, 90)))
+        for k in set(keys)
+    }
+
+    def norm(r):
+        if isinstance(r, Exception):
+            return (type(r).__name__, str(r))
+        return (r.allowed, r.limit, r.remaining, r.reset_after,
+                r.retry_after)
+
+    async def run():
+        front = FrontTier(DenyCache(4096), None)
+        eng_on = BatchingEngine(
+            TpuRateLimiter(capacity=512), batch_size=32, max_linger_us=200,
+            now_fn=lambda: clock["now"], front=front,
+        )
+        eng_off = BatchingEngine(
+            TpuRateLimiter(capacity=512), batch_size=32, max_linger_us=200,
+            now_fn=lambda: clock["now"],
+        )
+        for step in range(steps):
+            if rng.random() < 0.10:  # param churn on a random key
+                k = keys[int(rng.integers(len(keys)))]
+                key_params[k] = (
+                    int(rng.integers(2, 6)), int(rng.integers(1, 5)),
+                    int(rng.integers(10, 90)),
+                )
+            reqs = []
+            for k in keys[step * window : (step + 1) * window]:
+                burst, count, period = key_params[k]
+                q = 0 if rng.random() < 0.02 else 1
+                reqs.append(ThrottleRequest(k, burst, count, period, q))
+            got_on, got_off = await asyncio.gather(
+                asyncio.gather(
+                    *[eng_on.throttle(r) for r in reqs],
+                    return_exceptions=True,
+                ),
+                asyncio.gather(
+                    *[eng_off.throttle(r) for r in reqs],
+                    return_exceptions=True,
+                ),
+            )
+            for i, (a, b) in enumerate(zip(got_on, got_off)):
+                if norm(a) != norm(b):
+                    raise AssertionError(
+                        f"hotkey seed{seed} step{step} row {i} "
+                        f"({reqs[i]}): cache-on {norm(a)} != "
+                        f"cache-off {norm(b)}"
+                    )
+            TOTAL["requests"] += 2 * len(reqs)
+            TOTAL["windows"] += 2
+            clock["now"] += int(rng.integers(0, 3 * NS))
+            if rng.random() < 0.06:  # expiry jump: vacate buckets
+                clock["now"] += int(rng.integers(120, 600)) * NS
+        await eng_on.shutdown()
+        await eng_off.shutdown()
+        return front.deny_cache.hits
+
+    return asyncio.run(run())
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seeds", type=int, default=24)
@@ -281,6 +365,16 @@ def main() -> int:
         print(
             f"seed {3000 + s} ok — {TOTAL['requests']} requests, "
             f"tiers {TOTAL['tiers']}",
+            file=sys.stderr, flush=True,
+        )
+    # Deny-cache differential: one hot-key abuse seed per ladder seed,
+    # so fuzz campaigns exercise the front tier's exactness contract
+    # under fresh param-churn/clock-jump interleavings (not just the
+    # single CI-pinned seed).
+    for s in range(args.seeds):
+        hits = run_hotkey_deny_seed(4000 + s, args.steps * 2)
+        print(
+            f"hotkey seed {4000 + s} ok — {hits} deny-cache hits",
             file=sys.stderr, flush=True,
         )
     print(

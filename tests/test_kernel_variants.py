@@ -410,6 +410,41 @@ def test_w32_field_edges_roundtrip():
     assert W32_REM_MAX == 1023 and W32_RESET_MAX == 2047
 
 
+def test_w32_refuses_valid_bigtol_lane():
+    """Regression (ADVICE high): a VALID lane with tol >= 2^62 used to
+    wrap the certificate's int64 bound sums negative — tol + max(em, tol)
+    = 2^63 overflows — falsely certifying w32 for a lane whose true
+    reset is orders of magnitude past the 2047 s field (and whose stored
+    TAT >= 2^62 would corrupt cur_safe for later launches).  The bound
+    math must refuse at tol >= 2^61 before any arithmetic can wrap."""
+    from throttlecrab_tpu.tpu.kernel import fits_w32_wire
+
+    B = 4
+    em = np.full(B, NS, np.int64)
+    tol = em * 499  # in-field lanes: reset ~500 s < 2047 s
+    q = np.full(B, 1, np.int64)
+    valid = np.ones(B, bool)
+    # burst 5e6 at em 1000 s: a legal request whose tol crosses 2^62.
+    big = tol.copy()
+    em_big = em.copy()
+    em_big[2] = 1000 * NS
+    big[2] = em_big[2] * 5_000_000
+    assert int(big[2]) >= (1 << 62)
+    assert not fits_w32_wire(valid, em_big, big, q, BASE, 0)
+    # The exact refusal threshold is 2^61 (the wrap-free safety bound).
+    at_edge = tol.copy()
+    at_edge[2] = 1 << 61
+    assert not fits_w32_wire(valid, em, at_edge, q, BASE, 0)
+    below = tol.copy()
+    below[2] = (1 << 61) - 1
+    # Below the wrap bound the field-width checks decide (and a ~73-year
+    # tolerance overflows the 2047 s reset field anyway): still refused,
+    # but by the right check, without int64 wrap.
+    assert not fits_w32_wire(valid, em, below, q, BASE, 0)
+    # Sanity: the small-tol batch alone still certifies.
+    assert fits_w32_wire(valid, em, tol, q, BASE, int(tol.max()))
+
+
 def test_w32_respects_cross_launch_tol_hwm():
     """A stored TAT from an earlier big-tolerance launch can push a later
     launch's reset_s past the field width; the tol_hwm term in the

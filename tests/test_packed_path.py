@@ -610,6 +610,38 @@ class TestIds20Stream:
                 rows, np.zeros((1, 8), np.uint16), np.array([1], np.int64)
             )
 
+    def test_ids20_kernels_reject_misaligned_width(self):
+        """Regression (ADVICE low): the raw kernels derived B = W*4//5
+        without validating W % 5 == 0, so a direct caller handing a
+        misaligned buffer (e.g. a raw id stream) got its high-nibble
+        plane mis-split into plausible-but-wrong ids and decided against
+        the wrong buckets.  Both entry points must fail loudly instead."""
+        from throttlecrab_tpu.tpu.kernel import (
+            EMPTY_EXPIRY,
+            gcra_scan_ids20,
+            gcra_scan_ids20_acc,
+            pack_id_rows,
+            pack_state,
+        )
+
+        n = 4
+        em = np.full(n, NS, np.int64)
+        rows = jnp.asarray(
+            pack_id_rows(np.arange(n, dtype=np.int32), em, em * 2)
+        )
+        state = pack_state(
+            jnp.zeros((64,), jnp.int64),
+            jnp.full((64,), EMPTY_EXPIRY, jnp.int64),
+        )
+        bad = jnp.zeros((1, 8), jnp.uint16)  # 8 % 5 != 0
+        now = np.array([NS], np.int64)
+        with pytest.raises(ValueError, match="multiple of 5"):
+            gcra_scan_ids20(state, rows, bad, now, 1)
+        with pytest.raises(ValueError, match="multiple of 5"):
+            gcra_scan_ids20_acc(
+                state, jnp.zeros((), jnp.int64), rows, bad, now, 1
+            )
+
     def test_ids20_rejects_oversized_table(self):
         from throttlecrab_tpu.tpu.kernel import pack_id_rows, pack_ids20
         from throttlecrab_tpu.tpu.table import BucketTable

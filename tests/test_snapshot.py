@@ -74,6 +74,64 @@ def test_restore_carries_cur_state_certificate(tmp_path):
     h.fetch()
 
 
+def test_restore_pathological_foreign_tol_saturates(tmp_path):
+    """Regression (ADVICE low): `_bulk_insert` recovers each restored
+    entry's tolerance as expiry - tat to seed the w32 high-water mark.
+    A pathological foreign entry (negative tat under an I64_MAX expiry)
+    makes that difference exceed i64 — the vectorized numpy path must
+    saturate to note(None) (w32 off) instead of wrapping negative and
+    under-seeding the mark.  Normal entries still seed the exact max."""
+    import json
+
+    from throttlecrab_tpu.tpu.table import I64_MAX
+
+    def craft(path, keys, tats, expiries):
+        offsets = np.zeros(len(keys) + 1, np.int64)
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+        np.savez_compressed(
+            path,
+            version=np.int64(2),
+            capacity=np.int64(256),
+            slots=np.arange(len(keys), dtype=np.int64),
+            shard=np.zeros(len(keys), np.int32),
+            n_shards=np.int64(1),
+            tat=np.asarray(tats, np.int64),
+            expiry=np.asarray(expiries, np.int64),
+            key_offsets=offsets,
+            key_blob=np.frombuffer(b"".join(keys), np.uint8),
+            key_is_bytes=np.zeros(len(keys), np.uint8),
+            key_codec=np.zeros(len(keys), np.uint8),
+            source_bytes_keys=np.uint8(0),
+            meta=np.frombuffer(
+                json.dumps({"n_keys": len(keys)}).encode(), np.uint8
+            ),
+        )
+
+    path = tmp_path / "foreign.npz"
+    craft(
+        path,
+        [b"ok", b"poison"],
+        [T0, -(1 << 62)],
+        [T0 + 3600 * NS, I64_MAX],
+    )
+    lim = TpuRateLimiter(capacity=256)
+    with np.errstate(over="raise"):  # a wrap would raise, not corrupt
+        assert load_snapshot(lim, path, now_ns=T0) == 2
+    assert lim.table.tol_hwm == I64_MAX  # saturated: w32 stays off
+
+    # A well-formed snapshot still seeds the exact recovered max.
+    path2 = tmp_path / "normal.npz"
+    craft(
+        path2,
+        [b"a", b"b"],
+        [T0, T0 + NS],
+        [T0 + 60 * NS, T0 + 121 * NS],
+    )
+    lim2 = TpuRateLimiter(capacity=256)
+    assert load_snapshot(lim2, path2, now_ns=T0) == 2
+    assert lim2.table.tol_hwm == 120 * NS
+
+
 def test_restore_drops_expired_entries(tmp_path):
     path = tmp_path / "snap.npz"
     lim = TpuRateLimiter(capacity=64)

@@ -37,3 +37,14 @@ def test_tier_ladder_fuzz_slice(seed):
     before = fuzz.TOTAL["requests"]
     fuzz.run_seed(seed, steps=8, sharded_mesh=mesh)
     assert fuzz.TOTAL["requests"] > before
+
+
+def test_hotkey_abuse_deny_cache_slice():
+    """One seed of the hot-key abuse profile (harness `hotkey-abuse`
+    pattern) through the front tier's deny cache: cache-on and cache-off
+    decisions pinned equal request-by-request, and the cache must have
+    actually served (hits > 0 — equality alone would be vacuous)."""
+    before = fuzz.TOTAL["requests"]
+    hits = fuzz.run_hotkey_deny_seed(4000, steps=24)
+    assert fuzz.TOTAL["requests"] > before
+    assert hits > 0

@@ -375,7 +375,7 @@ def main(argv=None) -> int:
                    help="requests per worker")
     p.add_argument("--key-pattern", default="random",
                    choices=["sequential", "random", "zipfian",
-                            "user-resource"])
+                            "user-resource", "hotkey-abuse"])
     p.add_argument("--key-space", type=int, default=10_000)
     p.add_argument("--workload", default="steady",
                    choices=["steady", "burst", "ramp", "wave"])

@@ -3,8 +3,10 @@
 Re-designs the reference's workload vocabulary
 (`tests/integration/workload.rs:8-52`): request-arrival patterns
 Steady / Burst / Ramp / Wave and key patterns Sequential / Random /
-Zipfian / UserResource.  Patterns are expressed as *per-request delay
-schedules* (host side), so they compose with any transport client.
+Zipfian / UserResource, plus HotkeyAbuse (a deny-dominated attack mix
+the front tier's deny cache is built for).  Patterns are expressed as
+*per-request delay schedules* (host side), so they compose with any
+transport client.
 """
 
 from __future__ import annotations
@@ -69,6 +71,19 @@ def make_keys(
         users = rng.integers(0, max(key_space // 10, 1), n_requests)
         resources = rng.integers(0, 10, n_requests)
         return [f"user:{u}:res:{r}" for u, r in zip(users, resources)]
+    elif pattern == "hotkey-abuse":
+        # Abuse/attack traffic — the scenario rate limiters exist for:
+        # a handful of hot keys (~1/1000th of the key space, at least
+        # one) hammered far past their limit soak ~90% of the stream,
+        # so almost every hot-key request after the first burst is a
+        # deny; the rest is a benign random tail.  This is the shape
+        # the front tier's deny cache turns from the most expensive
+        # traffic into the cheapest (see throttlecrab_tpu/front/).
+        n_hot = max(key_space // 1000, 1)
+        hot = rng.integers(0, n_hot, n_requests)
+        cold = rng.integers(n_hot, max(key_space, n_hot + 1), n_requests)
+        is_hot = rng.random(n_requests) < 0.9
+        ids = np.where(is_hot, hot, cold)
     else:
         raise ValueError(f"unknown key pattern: {pattern!r}")
     return [f"key:{i}" for i in ids]

@@ -196,6 +196,8 @@ def _build_wire() -> Optional[ctypes.CDLL]:
     lib.ws_stats.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
     ]
+    lib.ws_queue_depth.restype = ctypes.c_int64
+    lib.ws_queue_depth.argtypes = [ctypes.c_void_p]
     return lib
 
 

@@ -16,7 +16,7 @@ import sys
 from .config import Config, ConfigError
 from .engine import BatchingEngine
 from .metrics import Metrics
-from .store import create_cleanup_policy, create_limiter
+from .store import create_cleanup_policy, create_front_tier, create_limiter
 
 log = logging.getLogger("throttlecrab")
 
@@ -48,6 +48,7 @@ def build_transports(config: Config, engine, metrics):
                     cleanup_policy=engine.cleanup_policy,
                     limiter_lock=engine.limiter_lock,
                     now_fn=engine.now_fn,
+                    front=engine.front,
                 )
             )
         else:
@@ -85,6 +86,7 @@ def build_transports(config: Config, engine, metrics):
                     cleanup_policy=native_policy,
                     limiter_lock=engine.limiter_lock,
                     now_fn=engine.now_fn,
+                    front=engine.front,
                 )
             )
         else:
@@ -155,6 +157,11 @@ async def run_server(config: Config) -> None:
                     limiter.sweep(1 << 62)
                 except Exception:
                     log.exception("post-restore-failure sweep failed")
+    # Front tier (L3.5): exact deny cache + admission control, shared
+    # by the asyncio engine and the native transports.  Built after the
+    # snapshot restore on purpose — the cache must start empty against
+    # restored foreign state.
+    front = create_front_tier(config, metrics, limiter)
     engine = BatchingEngine(
         limiter,
         batch_size=config.batch_size,
@@ -163,6 +170,7 @@ async def run_server(config: Config) -> None:
         cleanup_policy=create_cleanup_policy(config),
         metrics=metrics,
         profile_dir=config.profile_dir or None,
+        front=front,
     )
     transports = build_transports(config, engine, metrics)
     if cluster_nodes:

@@ -72,6 +72,20 @@ _SPEC = [
      "Number of devices to shard the bucket table over"),
     ("profile_dir", "THROTTLECRAB_PROFILE_DIR", "", str,
      "Directory for an xprof trace of the first launches (empty: off)"),
+    # --- front tier (L3.5: exact deny cache + admission control) -------
+    ("front_deny_cache", "THROTTLECRAB_FRONT_DENY_CACHE", 65536, int,
+     "Deny-cache capacity in entries: provably exact repeat denials "
+     "answer without a device launch (0 disables)"),
+    ("front_max_pending", "THROTTLECRAB_FRONT_MAX_PENDING", 100_000, int,
+     "Admission control: shed new arrivals with an overload status once "
+     "this many requests are already queued (0 disables; the reference's "
+     "full-channel backpressure, surfaced instead of silently awaited)"),
+    ("front_max_wait_us", "THROTTLECRAB_FRONT_MAX_WAIT_US", 0, int,
+     "Admission control: shed when the EWMA-estimated queue wait exceeds "
+     "this many microseconds (0 disables)"),
+    ("front_peek_frac", "THROTTLECRAB_FRONT_PEEK_FRAC", 0.9, float,
+     "Fraction of each admission bound at which quantity-0 peek probes "
+     "shed (they consume nothing; keep headroom for consuming checks)"),
     ("snapshot_path", "THROTTLECRAB_SNAPSHOT_PATH", "", str,
      "Snapshot file (.npz): restored at startup when present, written on "
      "graceful shutdown (empty: disabled; state is soft either way)"),
@@ -125,6 +139,10 @@ class Config:
     keymap: str = "auto"
     shards: int = 1
     profile_dir: str = ""
+    front_deny_cache: int = 65536
+    front_max_pending: int = 100_000
+    front_max_wait_us: int = 0
+    front_peek_frac: float = 0.9
     snapshot_path: str = ""
     cluster_nodes: str = ""
     cluster_index: int = 0
@@ -181,6 +199,12 @@ class Config:
             )
         if self.shards < 1:
             raise ConfigError("shards must be >= 1")
+        if self.front_deny_cache < 0:
+            raise ConfigError("front_deny_cache must be >= 0")
+        if self.front_max_pending < 0 or self.front_max_wait_us < 0:
+            raise ConfigError("front admission bounds must be >= 0")
+        if not 0.0 < self.front_peek_frac <= 1.0:
+            raise ConfigError("front_peek_frac must be in (0, 1]")
         nodes = self.cluster_node_list()
         if nodes:
             if not 0 <= self.cluster_index < len(nodes):

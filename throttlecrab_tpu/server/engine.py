@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 from typing import Optional
 
+from ..front import OverloadError  # re-exported for the transports
 from ..tpu.cleanup import CleanupPolicy
 from ..tpu.limiter import (
     STATUS_INTERNAL,
@@ -33,6 +34,8 @@ from ..tpu.limiter import (
     STATUS_OK,
 )
 from .types import ThrottleRequest, ThrottleResponse
+
+__all__ = ["BatchingEngine", "OverloadError", "ThrottleError"]
 
 STATUS_MESSAGES = {
     STATUS_NEGATIVE_QUANTITY: "quantity cannot be negative",
@@ -61,15 +64,22 @@ class BatchingEngine:
         profile_dir: Optional[str] = None,
         profile_launches: int = 50,
         max_scan_depth: int = 16,
+        front=None,
     ) -> None:
         """`limiter` is a TpuRateLimiter / ShardedTpuRateLimiter (or any
         object with rate_limit_batch + sweep).  `now_fn` injects time for
         tests (time is an input, never ambient — rate_limiter.rs:109).
-        `max_scan_depth` caps backlog sub-batches decided per launch."""
+        `max_scan_depth` caps backlog sub-batches decided per launch.
+        `front` is an optional front.FrontTier (L3.5): requests are run
+        through its admission control (shed with OverloadError instead
+        of queueing unboundedly) and its exact deny cache (repeat
+        denials answered without a device launch) before they ever
+        reach the pending queue."""
         import threading
         import time
 
         self.limiter = limiter
+        self.front = front
         # Serializes device access with native transports that drive the
         # same limiter from their own threads (server/native_redis.py).
         self.limiter_lock = threading.Lock()
@@ -80,12 +90,23 @@ class BatchingEngine:
         # the other.
         import inspect
 
+        # A deny-caching front tier needs the exact observed-TAT plane
+        # (result.cur_ns) to certify entries — ask limiters that support
+        # it to collect it (they trade the w32 tier's halved fetch for
+        # the cur tier's TAT plane; decisions are identical).
+        want_cur = front is not None and front.deny_cache is not None
+
         def wire_kw(fn):
             try:
                 params = inspect.signature(fn).parameters
             except (TypeError, ValueError):
                 return {}
-            return {"wire": True} if "wire" in params else {}
+            kw = {}
+            if "wire" in params:
+                kw["wire"] = True
+            if want_cur and "collect_cur" in params:
+                kw["collect_cur"] = True
+            return kw
 
         self._wire_kw = wire_kw(limiter.rate_limit_batch)
         self._wire_many_kw = wire_kw(
@@ -117,9 +138,37 @@ class BatchingEngine:
     # ------------------------------------------------------------------ #
 
     async def throttle(self, request: ThrottleRequest) -> ThrottleResponse:
-        """Decide one request; resolves when its batch comes back."""
+        """Decide one request; resolves when its batch comes back.
+
+        With a front tier attached the request first consults the deny
+        cache (a provably exact repeat denial returns immediately — no
+        queue slot, no device launch), then passes admission control
+        (OverloadError when shed — each transport maps it to its
+        protocol's overload status).  Cache hits bypass admission on
+        purpose: they never occupy the queue the controller protects,
+        and under the abuse traffic that fills the queue they are the
+        relief valve, not the load."""
         if self._closed:
             raise ThrottleError("engine is shut down")
+        front = self.front
+        if front is not None:
+            hit = front.lookup(
+                request.key, request.max_burst, request.count_per_period,
+                request.period, request.quantity, self.now_fn(),
+            )
+            if hit is not None:
+                return ThrottleResponse(
+                    allowed=False,
+                    limit=hit.limit,
+                    remaining=hit.remaining,
+                    reset_after=hit.reset_after_s,
+                    retry_after=hit.retry_after_s,
+                )
+            if not front.admit(len(self._pending), request.quantity == 0):
+                raise OverloadError()
+            # From here until this request's result is observed, same-key
+            # lookups must miss (we may be about to mutate the bucket).
+            front.begin_inflight(request.key)
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         self._pending.append((request, fut))
@@ -183,7 +232,14 @@ class BatchingEngine:
                         from ..tpu.profiling import annotate
 
                         with self.limiter_lock, annotate("gcra_dispatch"):
-                            return self.limiter.dispatch_many(
+                            # Dispatch-order stamp for the deny cache:
+                            # taken under the same lock that serializes
+                            # device launches across transports, so seq
+                            # order == launch order.
+                            seq = (
+                                self.front.next_seq() if self.front else 0
+                            )
+                            return seq, self.limiter.dispatch_many(
                                 [
                                     (
                                         [r.key for r, _ in w],
@@ -202,10 +258,10 @@ class BatchingEngine:
                             )
 
                     try:
-                        handle = await loop.run_in_executor(
+                        seq, handle = await loop.run_in_executor(
                             None, do_dispatch
                         )
-                        launched = (windows, handle, now_ns)
+                        launched = (windows, handle, now_ns, seq)
                     except Exception as exc:
                         self._fail_windows(windows, exc)
 
@@ -234,32 +290,90 @@ class BatchingEngine:
             for i in range(0, take, self.batch_size)
         ]
 
-    @staticmethod
-    def _fail_windows(windows, exc) -> None:
+    def _fail_windows(self, windows, exc) -> None:
+        front = self.front
+        if front is not None and front.deny_cache is not None:
+            # The launch may have COMMITTED before the failure (a fetch
+            # error lands here too): release the holds and drop the
+            # keys' cached denials/write records — an unobserved allow
+            # may have moved their TATs.
+            front.fail_window(
+                [r.key for window in windows for r, _ in window]
+            )
         for window in windows:
             for _, fut in window:
                 if not fut.done():
                     fut.set_exception(ThrottleError(str(exc)))
 
+    def _observe_window(self, window, result, now_ns, seq) -> None:
+        """Feed one decided window's rows to the front tier (in arrival
+        order): allowed rows invalidate/refresh write records, denied
+        rows may certify deny-cache entries, and every row releases its
+        in-flight hold."""
+        front = self.front
+        cur = getattr(result, "cur_ns", None)
+        wire = hasattr(result, "reset_after_s")
+        # One C-level tolist() per plane instead of a numpy scalar
+        # round trip per row — per-element int(arr[i]) is ~10x the cost
+        # and this loop runs once per engine-decided request.
+        status_l = result.status.tolist()
+        allowed_l = result.allowed.tolist()
+        cur_l = cur.tolist() if cur is not None else None
+        for i, (r, _) in enumerate(window):
+            try:
+                if status_l[i] != STATUS_OK:
+                    continue
+                allowed = bool(allowed_l[i])
+                kw = {}
+                if cur_l is not None:
+                    kw["cur_ns"] = cur_l[i]
+                elif wire:
+                    # Whole-second planes cannot reconstruct the exact
+                    # TAT; denials can't certify, but allowed rows must
+                    # still invalidate cached denials for the key.
+                    if not allowed:
+                        continue
+                else:
+                    kw["reset_after_ns"] = int(result.reset_after_ns[i])
+                    kw["retry_after_ns"] = int(result.retry_after_ns[i])
+                front.observe(
+                    r.key, r.max_burst, r.count_per_period, r.period,
+                    r.quantity, now_ns, allowed, seq, **kw,
+                )
+            finally:
+                front.end_inflight(r.key)
+
     async def _fetch_complete(self, in_flight) -> None:
         """Fetch an in-flight launch's results and resolve its futures."""
-        windows, handle, now_ns = in_flight
+        windows, handle, now_ns, seq = in_flight
         loop = asyncio.get_running_loop()
+        import time
+
+        t0 = time.monotonic()
         try:
             results = await loop.run_in_executor(None, handle.fetch)
         except Exception as exc:
             self._fail_windows(windows, exc)
             return
+        elapsed = time.monotonic() - t0
         total = 0
         for window, result in zip(windows, results):
             total += len(window)
             self._complete(window, result)
+            if self.front is not None and self.front.deny_cache is not None:
+                # Admission-only fronts skip the per-row observe loop:
+                # every call inside it would be a no-op.
+                self._observe_window(window, result, now_ns, seq)
+        if self.front is not None:
+            self.front.record_launch(total, elapsed)
         if self.metrics is not None:
             self.metrics.record_launch(total)
         await self._maybe_sweep(now_ns, total)
 
     async def _decide_many(self, windows) -> None:
         """Backlog path: K sub-batches, one launch, shared timestamp."""
+        import time
+
         now_ns = self.now_fn()
         loop = asyncio.get_running_loop()
         self._profile_tick()
@@ -268,7 +382,8 @@ class BatchingEngine:
             from ..tpu.profiling import annotate
 
             with self.limiter_lock, annotate("gcra_scan_decide"):
-                return self.limiter.rate_limit_many(
+                seq = self.front.next_seq() if self.front else 0
+                return seq, self.limiter.rate_limit_many(
                     [
                         (
                             [r.key for r, _ in window],
@@ -283,26 +398,32 @@ class BatchingEngine:
                     **self._wire_many_kw,
                 )
 
+        t0 = time.monotonic()
         try:
-            results = await loop.run_in_executor(None, launch)
+            seq, results = await loop.run_in_executor(None, launch)
         except Exception as exc:
-            for window in windows:
-                for _, fut in window:
-                    if not fut.done():
-                        fut.set_exception(ThrottleError(str(exc)))
+            self._fail_windows(windows, exc)
             return
+        elapsed = time.monotonic() - t0
 
         total = 0
         for window, result in zip(windows, results):
             total += len(window)
             self._complete(window, result)
+            if self.front is not None and self.front.deny_cache is not None:
+                # Admission-only fronts skip the per-row observe loop:
+                # every call inside it would be a no-op.
+                self._observe_window(window, result, now_ns, seq)
+        if self.front is not None:
+            self.front.record_launch(total, elapsed)
         if self.metrics is not None:
             self.metrics.record_launch(total)
         await self._maybe_sweep(now_ns, total)
 
     async def _decide(self, batch) -> None:
+        import time
+
         requests = [r for r, _ in batch]
-        futures = [f for _, f in batch]
         now_ns = self.now_fn()
         loop = asyncio.get_running_loop()
         self._profile_tick()
@@ -311,7 +432,8 @@ class BatchingEngine:
             from ..tpu.profiling import annotate
 
             with self.limiter_lock, annotate("gcra_batch_decide"):
-                return self.limiter.rate_limit_batch(
+                seq = self.front.next_seq() if self.front else 0
+                return seq, self.limiter.rate_limit_batch(
                     [r.key for r in requests],
                     [r.max_burst for r in requests],
                     [r.count_per_period for r in requests],
@@ -321,17 +443,20 @@ class BatchingEngine:
                     **self._wire_kw,
                 )
 
+        t0 = time.monotonic()
         try:
-            result = await loop.run_in_executor(None, launch)
+            seq, result = await loop.run_in_executor(None, launch)
         except Exception as exc:  # internal failure fails the whole batch
-            for fut in futures:
-                if not fut.done():
-                    fut.set_exception(ThrottleError(str(exc)))
+            self._fail_windows([batch], exc)
             return
 
+        if self.front is not None:
+            self.front.record_launch(len(batch), time.monotonic() - t0)
         if self.metrics is not None:
             self.metrics.record_launch(len(batch))
         self._complete(batch, result)
+        if self.front is not None and self.front.deny_cache is not None:
+            self._observe_window(batch, result, now_ns, seq)
         await self._maybe_sweep(now_ns, len(batch))
 
     @staticmethod
@@ -451,6 +576,10 @@ class BatchingEngine:
             freed, drained = await loop.run_in_executor(
                 None, locked_policy_step
             )
+            if freed is not None and self.front is not None:
+                # Swept buckets are gone even for a later regressed
+                # clock: drop the deny-cache entries they backed.
+                self.front.on_sweep(now_ns)
             if self.metrics is not None:
                 if drained:
                     self.metrics.record_expired_hits(drained)

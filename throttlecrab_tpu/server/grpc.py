@@ -20,7 +20,7 @@ from typing import Optional
 import grpc
 import grpc.aio
 
-from .engine import BatchingEngine, ThrottleError
+from .engine import BatchingEngine, OverloadError, ThrottleError
 from .metrics import Metrics
 from .proto import throttlecrab_pb2 as pb
 from .types import ThrottleRequest
@@ -97,6 +97,11 @@ class GrpcTransport:
         )
         try:
             response = await self.engine.throttle(internal)
+        except OverloadError as e:
+            # Shed by admission control: RESOURCE_EXHAUSTED is gRPC's
+            # overload status (clients back off; INTERNAL means a bug).
+            self.metrics.record_error(self.name)
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except ThrottleError as e:
             self.metrics.record_error(self.name)
             await context.abort(grpc.StatusCode.INTERNAL, str(e))

@@ -20,7 +20,7 @@ import json
 import logging
 from typing import Optional
 
-from .engine import BatchingEngine, ThrottleError
+from .engine import BatchingEngine, OverloadError, ThrottleError
 from .metrics import Metrics
 from .transport_base import ConnTrackingMixin
 from .types import ThrottleRequest
@@ -167,6 +167,16 @@ class HttpTransport(ConnTrackingMixin):
             )
         try:
             response = await self.engine.throttle(request)
+        except OverloadError as e:
+            # Shed by admission control: 503, the HTTP overload status
+            # (NOT 500 — clients must distinguish "back off" from
+            # "server bug").
+            self.metrics.record_error(self.name)
+            return (
+                503,
+                json.dumps({"error": str(e)}).encode(),
+                "application/json",
+            )
         except ThrottleError as e:
             self.metrics.record_error(self.name)
             return (
@@ -192,7 +202,8 @@ class HttpTransport(ConnTrackingMixin):
         self, writer, status, payload, content_type, keep_alive
     ) -> None:
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  500: "Internal Server Error"}.get(status, "OK")
+                  500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(status, "OK")
         head = (
             f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: {content_type}\r\n"

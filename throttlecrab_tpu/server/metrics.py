@@ -86,6 +86,12 @@ class Metrics:
         self.sweeps = 0
         self.slots_freed = 0
         self.expired_hits = 0
+        # Front tier (L3.5: deny cache + admission control).
+        self.front_deny_hits = 0
+        self.front_shed_peek = 0
+        self.front_shed_consume = 0
+        self.front_stale_evictions = 0
+        self._front_stats = None
 
     @classmethod
     def builder(cls) -> "MetricsBuilder":
@@ -120,10 +126,13 @@ class Metrics:
             self.requests_errors += 1
 
     def record_batch(
-        self, transport, n_allowed, n_denied, n_errors, denied_keys, batch
+        self, transport, n_allowed, n_denied, n_errors, denied_keys, batch,
+        launches: int = 1,
     ) -> None:
         """One aggregated update per device launch (thread-safe: native
-        transports drive from their own threads)."""
+        transports drive from their own threads).  `launches=0` records
+        a window answered entirely without the device (deny-cache hits
+        and shed rows only)."""
         with self._lock:
             n = n_allowed + n_denied + n_errors
             self.requests_total += n
@@ -135,9 +144,10 @@ class Metrics:
             if self.top_denied is not None:
                 for key in denied_keys:
                     self.top_denied.record(key)
-            self.device_launches += 1
-            self.batched_requests += batch
-            self.max_batch = max(self.max_batch, batch)
+            self.device_launches += launches
+            if launches:
+                self.batched_requests += batch
+                self.max_batch = max(self.max_batch, batch)
 
     def record_launch(self, batch_size: int) -> None:
         self.device_launches += 1
@@ -153,6 +163,37 @@ class Metrics:
         device-side count, drained via the cleanup policy path)."""
         with self._lock:
             self.expired_hits += n
+
+    # ---- front tier (L3.5) ------------------------------------------- #
+
+    def record_front_hit(self) -> None:
+        """A denial served exactly from the deny cache (no launch)."""
+        with self._lock:
+            self.front_deny_hits += 1
+
+    def record_front_hits(self, n: int) -> None:
+        """Bulk form: one window's deny-cache hit count."""
+        with self._lock:
+            self.front_deny_hits += n
+
+    def record_front_shed(self, peek: bool) -> None:
+        """A request shed by admission control, by priority class."""
+        with self._lock:
+            if peek:
+                self.front_shed_peek += 1
+            else:
+                self.front_shed_consume += 1
+
+    def record_front_stale(self, n: int) -> None:
+        """Deny-cache entries evicted because their proven window (or
+        their bucket's TTL) lapsed."""
+        with self._lock:
+            self.front_stale_evictions += n
+
+    def set_front_stats_provider(self, provider) -> None:
+        """`provider()` -> {"deny_cache_size": n}; exported as gauges
+        (FrontTier.stats)."""
+        self._front_stats = provider
 
     def set_cluster_stats_provider(self, provider) -> None:
         """`provider()` -> {peer_addr: {"forwarded": n, "failed": n}};
@@ -262,6 +303,41 @@ class Metrics:
             "Slots freed by compaction sweeps",
             "counter",
             self.slots_freed,
+        )
+        # Front tier (L3.5): exact deny cache + admission control.
+        metric(
+            "throttlecrab_tpu_front_deny_hits",
+            "Denials served exactly from the deny cache "
+            "(no engine round trip)",
+            "counter",
+            self.front_deny_hits,
+        )
+        out.append(
+            "# HELP throttlecrab_tpu_front_shed Requests shed by "
+            "admission control, by priority class"
+        )
+        out.append("# TYPE throttlecrab_tpu_front_shed counter")
+        out.append(
+            'throttlecrab_tpu_front_shed{class="peek"} '
+            f"{self.front_shed_peek}"
+        )
+        out.append(
+            'throttlecrab_tpu_front_shed{class="consume"} '
+            f"{self.front_shed_consume}"
+        )
+        metric(
+            "throttlecrab_tpu_front_stale_evictions",
+            "Deny-cache entries evicted after their proven window "
+            "or bucket TTL lapsed",
+            "counter",
+            self.front_stale_evictions,
+        )
+        front_stats = self._front_stats() if self._front_stats else {}
+        metric(
+            "throttlecrab_tpu_front_deny_cache_size",
+            "Live deny-cache entries",
+            "gauge",
+            front_stats.get("deny_cache_size", 0),
         )
         provider = getattr(self, "_cluster_stats", None)
         if provider is not None:

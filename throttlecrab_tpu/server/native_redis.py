@@ -27,8 +27,13 @@ from typing import Optional
 
 import numpy as np
 
+from ..front import STATUS_OVERLOADED
 from ..native import get_wire_lib
-from ..tpu.limiter import STATUS_INTERNAL, limiter_uses_bytes_keys
+from ..tpu.limiter import (
+    STATUS_INTERNAL,
+    WireBatchResult,
+    limiter_uses_bytes_keys,
+)
 
 log = logging.getLogger("throttlecrab.redis.native")
 
@@ -53,6 +58,7 @@ class NativeRedisTransport:
         limiter_lock: Optional[threading.Lock] = None,
         now_fn=None,
         max_scan_depth: int = 16,
+        front=None,
     ) -> None:
         lib = get_wire_lib()
         if lib is None:
@@ -62,6 +68,29 @@ class NativeRedisTransport:
         self.port = port
         self.limiter = limiter
         self.metrics = metrics
+        # Front tier (L3.5): shared with the asyncio engine, so a deny
+        # cached on one transport serves (and is invalidated by) all of
+        # them.  The lookup runs in this driver BEFORE batch prep —
+        # cache-hit rows never reach tk_prepare_batch or the device.
+        self.front = front
+        # Ask cur-capable dispatchers for the observed-TAT plane only
+        # when a deny cache is attached (see engine.py).
+        def cur_kw(method_name):
+            if front is None or front.deny_cache is None:
+                return {}
+            import inspect
+
+            try:
+                params = inspect.signature(
+                    getattr(limiter, method_name)
+                ).parameters
+            except (AttributeError, TypeError, ValueError):
+                return {}
+            return {"collect_cur": True} if "collect_cur" in params else {}
+
+        self._collect_cur_kw = cur_kw("dispatch_wire_window")
+        self._collect_cur_many_kw = cur_kw("rate_limit_many")
+        self._collect_cur_batch_kw = cur_kw("rate_limit_batch")
         self.batch_size = batch_size
         self.max_linger_us = max_linger_us
         self.max_scan_depth = max_scan_depth
@@ -205,9 +234,174 @@ class NativeRedisTransport:
                 if not self._running:
                     return
 
-    def _decide_window(self, batches) -> None:
-        now_ns = self.now_fn()
+    def _front_filter(self, batch, now_ns, depth):
+        """Run one captured frame through the front tier BEFORE batch
+        prep: deny-cache hits get their exact denial filled in,
+        admission-shed rows get the overload status, and only the
+        surviving misses are compacted into a (blob, offsets, params)
+        frame for the device.  The cache is consulted first — a hit
+        never occupies the queue admission protects, so shedding it
+        would turn a free exact denial into a 503 under exactly the
+        abuse traffic this tier exists for.  Miss keys are marked
+        in-flight until observed."""
+        blob, offsets, params, gen, fd = batch
+        n = len(offsets) - 1
+        front = self.front
+        admission = front.admission
+        deny = front.deny_cache
+        status_pre = np.zeros(n, np.uint8)
+        hit_vals = np.zeros((n, 5), np.int64)
+        NS = 1_000_000_000
+        q_col = params[:, 3].tolist()
+        miss_pos: list = []
+        miss_keys: list = []
+        miss_norm: list = []
+        if deny is not None:
+            raw = [blob[offsets[i] : offsets[i + 1]] for i in range(n)]
+            # The cache's key identity is the limiter keymap's: with a
+            # str-keyed (python) keymap the wire's bytes decode exactly
+            # like the transports do; with a bytes keymap (native, the
+            # serving default) normalization is the identity and costs
+            # nothing.
+            if front.bytes_keys:
+                norm = raw
+            else:
+                norm = [k.decode("utf-8", "surrogateescape") for k in raw]
+            # Bulk lookup, one lock + one computation per distinct
+            # (key, params, q) combo; misses are marked in-flight until
+            # _observe_plan releases them.
+            rows, _ = front.lookup_window(
+                norm, params[:, 0], params[:, 1], params[:, 2],
+                params[:, 3], now_ns,
+            )
+            shed_norm: list = []
+            for i in range(n):
+                hit = rows[i]
+                if hit is not None:
+                    status_pre[i] = 255  # marker: row served from cache
+                    hit_vals[i] = (
+                        0, hit[0], hit[1], hit[2] // NS, hit[3] // NS,
+                    )
+                    continue
+                if admission is not None and not front.admit(
+                    depth, q_col[i] == 0
+                ):
+                    status_pre[i] = STATUS_OVERLOADED
+                    shed_norm.append(norm[i])
+                    continue
+                miss_pos.append(i)
+                miss_keys.append(raw[i])
+                miss_norm.append(norm[i])
+            if shed_norm:
+                # Shed rows never reach the engine: release the
+                # in-flight holds the bulk lookup took for them.
+                front.release_window(shed_norm)
+        else:
+            # Admission-only config: no cache, so the per-row key
+            # slices/decodes are never needed — shed or pass through.
+            for i in range(n):
+                if admission is not None and not front.admit(
+                    depth, q_col[i] == 0
+                ):
+                    status_pre[i] = STATUS_OVERLOADED
+                else:
+                    miss_pos.append(i)
+            if len(miss_pos) != n:
+                miss_keys = [
+                    blob[offsets[i] : offsets[i + 1]] for i in miss_pos
+                ]
+        miss_idx = np.asarray(miss_pos, np.int64)
+        m = len(miss_pos)
+        if m == n:
+            miss_frame = (blob, offsets, params)
+            miss_params = params
+        elif m:
+            offsets_m = np.zeros(m + 1, np.int64)
+            np.cumsum([len(k) for k in miss_keys], out=offsets_m[1:])
+            miss_params = np.ascontiguousarray(params[miss_idx])
+            miss_frame = (b"".join(miss_keys), offsets_m, miss_params)
+        else:
+            miss_frame = None
+            miss_params = None
+        return {
+            "batch": batch,
+            "n": n,
+            "status_pre": status_pre,
+            "hit_vals": hit_vals,
+            "miss_idx": miss_idx,
+            "miss_norm": miss_norm,
+            "miss_frame": miss_frame,
+            "miss_params": miss_params,
+        }
+
+    def _merge_plan(self, plan, res):
+        """Fold a miss sub-frame's device results back into the full
+        frame alongside cached hits and shed rows; returns the
+        WireBatchResult-shaped object _respond_one serializes."""
+        n = plan["n"]
+        out = np.zeros((n, 5), np.int64)
+        status = plan["status_pre"].copy()
+        served = status == 255  # cache-hit marker → status OK on the wire
+        if bool(served.any()):
+            out[served] = plan["hit_vals"][served]
+            status[served] = 0
+        mi = plan["miss_idx"]
+        if len(mi):
+            if res is None:
+                status[mi] = STATUS_INTERNAL
+            else:
+                status[mi] = res.status
+                out[mi, 0] = res.allowed
+                out[mi, 1] = res.limit
+                out[mi, 2] = res.remaining
+                out[mi, 3] = res.reset_after_s
+                out[mi, 4] = res.retry_after_s
+        return WireBatchResult(
+            allowed=out[:, 0], limit=out[:, 1], remaining=out[:, 2],
+            reset_after_s=out[:, 3], retry_after_s=out[:, 4],
+            status=status,
+        )
+
+    def _observe_plan(self, plan, res, now_ns, seq) -> None:
+        """Feed the miss rows' engine decisions to the deny cache and
+        release their in-flight holds, in bulk (one lock for the whole
+        window) — the native twin of engine._observe_window."""
+        front = self.front
+        norm = plan["miss_norm"]
+        if res is None:
+            # Post-launch failure: the writes may have committed, so
+            # drop the keys' cached denials/write records along with
+            # their holds.
+            front.deny_cache.fail_window(norm)
+            return
+        params = plan["miss_params"]
+        cur = getattr(res, "cur_ns", None)
+        # One C-level tolist() per plane; per-element int(arr[i]) costs
+        # ~10x and this loop runs once per device-decided request.
+        status = res.status.tolist()
+        allowed_col = res.allowed.tolist()
+        cur_l = cur.tolist() if cur is not None else None
+        params_l = params.tolist()
+        rows = []
+        for i, key in enumerate(norm):
+            ok = status[i] == 0
+            allowed = ok and bool(allowed_col[i])
+            # Without the exact observed TAT (cur tier), a denial can't
+            # certify — but an allowed row must still invalidate.
+            c = cur_l[i] if (ok and cur_l is not None) else None
+            p = params_l[i]
+            rows.append((key, p[0], p[1], p[2], p[3], allowed, c))
+        front.observe_window(rows, now_ns, seq)
+
+    def _decide_frames(self, frames, now_ns):
+        """Decide a window of (blob, offsets, params) frames on the
+        device; returns (results, seq) with one WireBatchResult (or
+        None after a post-launch failure) per frame."""
+        if not frames:
+            return [], 0
         results = None
+        seq = 0
+        front = self.front
         # Fast path: hand the raw wire frames to the fully-native prep —
         # one C++ call per batch validates, derives the GCRA params, and
         # writes the packed launch rows (limiter.dispatch_wire_window).
@@ -216,8 +410,11 @@ class NativeRedisTransport:
         if wire_dispatch is not None:
             try:
                 with self.limiter_lock:
+                    # Dispatch-order stamp under the same lock that
+                    # serializes launches across transports.
+                    seq = front.next_seq() if front is not None else 0
                     handle = wire_dispatch(
-                        [(b, o, p) for b, o, p, _, _ in batches], now_ns
+                        frames, now_ns, **self._collect_cur_kw
                     )
             except Exception:
                 # Failed BEFORE any launch committed state: the Python
@@ -233,10 +430,11 @@ class NativeRedisTransport:
                 # them.  Re-deciding would debit every bucket twice, so
                 # answer internal errors instead of falling back.
                 log.exception("native wire fetch failed (post-launch)")
-                results = [None] * len(batches)
+                results = [None] * len(frames)
         if results is None:
             try:
                 with self.limiter_lock:
+                    seq = front.next_seq() if front is not None else 0
                     # wire=True: compact i32 whole-second outputs straight
                     # off the device — the RESP/HTTP reply units — plus
                     # the degenerate machinery compiled out when
@@ -247,23 +445,66 @@ class NativeRedisTransport:
                             p[:, 0], p[:, 1], p[:, 2], p[:, 3],
                             now_ns,
                         )
-                        for b, o, p, _, _ in batches
+                        for b, o, p in frames
                     ]
                     if (
                         hasattr(self.limiter, "rate_limit_many")
                         and len(windows) > 1
                     ):
                         results = self.limiter.rate_limit_many(
-                            windows, wire=True
+                            windows, wire=True,
+                            **self._collect_cur_many_kw,
                         )
                     else:
                         results = [
-                            self.limiter.rate_limit_batch(*w, wire=True)
+                            self.limiter.rate_limit_batch(
+                                *w, wire=True,
+                                **self._collect_cur_batch_kw,
+                            )
                             for w in windows
                         ]
             except Exception:
                 log.exception("native redis decide failed")
-                results = [None] * len(batches)
+                results = [None] * len(frames)
+        return results, seq
+
+    def _decide_window(self, batches) -> None:
+        now_ns = self.now_fn()
+        front = self.front
+        use_front = front is not None and (
+            front.deny_cache is not None or front.admission is not None
+        )
+        if use_front:
+            depth = int(self._lib.ws_queue_depth(self._h))
+            plans = [
+                self._front_filter(b, now_ns, depth) for b in batches
+            ]
+            frames = [
+                p["miss_frame"] for p in plans
+                if p["miss_frame"] is not None
+            ]
+        else:
+            plans = None
+            frames = [(b, o, p) for b, o, p, _, _ in batches]
+        launched_n = sum(len(f[1]) - 1 for f in frames)
+        t0 = time.monotonic()
+        results, seq = self._decide_frames(frames, now_ns)
+        if frames and front is not None:
+            front.record_launch(launched_n, time.monotonic() - t0)
+        any_launch = bool(frames)
+        if plans is not None:
+            # Re-align miss results with their plans, observe the engine
+            # rows, and merge hits/sheds/engine decisions per frame.
+            merged = []
+            it = iter(results)
+            for plan in plans:
+                res = (
+                    next(it) if plan["miss_frame"] is not None else None
+                )
+                if front.deny_cache is not None:
+                    self._observe_plan(plan, res, now_ns, seq)
+                merged.append(self._merge_plan(plan, res))
+            results = merged
         # Metrics: ONE aggregated record for the whole window — it was
         # one device launch (record_batch bumps device_launches, so
         # per-sub-batch calls would overcount launches by up to
@@ -274,7 +515,6 @@ class NativeRedisTransport:
             self.metrics is not None
             and self.metrics.top_denied is not None
         )
-        any_launch = False
         for (blob, offsets, _p, gen, fd), res in zip(batches, results):
             n_a, n_d, n_e, dk = self._respond_one(
                 blob, offsets, gen, fd, res, track_denied
@@ -293,7 +533,14 @@ class NativeRedisTransport:
                 n_denied=tot_denied,
                 n_errors=tot_errors,
                 denied_keys=denied_keys,
-                batch=tot_allowed + tot_denied + tot_errors,
+                # Only requests that actually rode the launch count
+                # toward the batching/coalescing gauges.
+                batch=(
+                    launched_n
+                    if plans is not None
+                    else tot_allowed + tot_denied + tot_errors
+                ),
+                launches=1 if frames else 0,
             )
         self._maybe_sweep(now_ns, sum(len(b[1]) - 1 for b in batches))
 
@@ -381,6 +628,10 @@ class NativeRedisTransport:
                     )
                 freed = self.limiter.sweep(now_ns)
                 policy.after_sweep(now_ns, freed, live)
+        if freed is not None and self.front is not None:
+            # Swept buckets are gone even for a later regressed clock:
+            # drop the deny-cache entries they backed.
+            self.front.on_sweep(now_ns)
         if self.metrics is not None:
             if n_hits:
                 self.metrics.record_expired_hits(n_hits)

@@ -15,7 +15,7 @@ import asyncio
 import logging
 from typing import Optional
 
-from .engine import BatchingEngine, ThrottleError
+from .engine import BatchingEngine, OverloadError, ThrottleError
 from .metrics import Metrics
 from .transport_base import ConnTrackingMixin
 from .resp import (
@@ -212,6 +212,10 @@ class RedisTransport(ConnTrackingMixin):
         )
         try:
             response = await self.engine.throttle(request)
+        except OverloadError as e:
+            # Shed by admission control; RESP has one error channel, so
+            # the overload status is the distinguished message text.
+            return Error(f"ERR {e}")
         except ThrottleError as e:
             return Error(f"ERR {e}")
         return Array(

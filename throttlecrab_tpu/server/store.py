@@ -31,6 +31,50 @@ def create_limiter(config):
     )
 
 
+def create_front_tier(config, metrics, limiter):
+    """Build the front tier (L3.5: exact deny cache + admission
+    control) from the THROTTLECRAB_FRONT_* knobs, or None when both
+    halves are disabled.  One instance is shared by the asyncio engine
+    and every native transport driving the same limiter."""
+    import inspect
+
+    from ..front import AdmissionController, DenyCache, FrontTier
+    from ..tpu.limiter import limiter_uses_bytes_keys
+
+    # A deny cache can only certify entries when the limiter exposes the
+    # exact observed TAT: either the cur tier (collect_cur) or, for
+    # non-wire limiters, the full-ns result planes.  Sharded/cluster
+    # limiters offer neither today — the cache would stay permanently
+    # empty while every request still paid its lookup/in-flight
+    # bookkeeping, so build only the admission half for them.
+    try:
+        params = inspect.signature(limiter.rate_limit_batch).parameters
+    except (AttributeError, TypeError, ValueError):
+        params = {}
+    certifiable = "collect_cur" in params or "wire" not in params
+    deny = (
+        DenyCache(config.front_deny_cache)
+        if config.front_deny_cache > 0 and certifiable
+        else None
+    )
+    admission = None
+    if config.front_max_pending or config.front_max_wait_us:
+        admission = AdmissionController(
+            max_pending=config.front_max_pending,
+            max_wait_us=config.front_max_wait_us,
+            peek_frac=config.front_peek_frac,
+        )
+    if deny is None and admission is None:
+        return None
+    front = FrontTier(
+        deny, admission, metrics=metrics,
+        bytes_keys=limiter_uses_bytes_keys(limiter),
+    )
+    if metrics is not None:
+        metrics.set_front_stats_provider(front.stats)
+    return front
+
+
 def create_cleanup_policy(config) -> CleanupPolicy:
     """store.rs:57-87: the store type decides when cleanup runs."""
     if config.store == "periodic":

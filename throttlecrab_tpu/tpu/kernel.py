@@ -219,6 +219,14 @@ def fits_w32_wire(
     em = np.where(v, np.asarray(emission, np.int64), 1)
     tol = np.where(v, np.asarray(tolerance, np.int64), 0)
     q = np.where(v, np.asarray(quantity, np.int64), 0)
+    if int(tol.max(initial=0)) >= (1 << 61):
+        # A legal big-tolerance lane (e.g. burst 5e6 at em 1000 s) wraps
+        # the int64 bound sums below and would falsely certify w32 while
+        # the true reset is orders of magnitude past the 2047 s field —
+        # and the stored TAT >= 2^62 would corrupt cur_safe for later
+        # launches.  Mirror TK_PREP_BIGTOL / fits_w32_wire_agg's C++
+        # twin: refuse before any arithmetic can wrap.
+        return False
     hwm = max(hwm, int(tol.max(initial=0)))
     em_safe = np.maximum(em, 1)  # degen-free cert guarantees em > 0
     inc = em * q
@@ -1213,6 +1221,15 @@ def gcra_scan_ids20(
     in-range check masks it exactly like a negative id).
     """
     W = packed.shape[1]
+    if W % 5:
+        # A misaligned buffer (e.g. a raw id stream handed to the wrong
+        # kernel) would mis-split the high-nibble plane into in-range
+        # garbage ids and decide against the wrong buckets; fail loudly
+        # instead (pack_ids20 / check_many_ids20 enforce the same
+        # contract for indirect callers).
+        raise ValueError(
+            f"ids20 stream width must be a multiple of 5 (got {W})"
+        )
     B = W * 4 // 5
 
     def step(state, kb):
@@ -1236,6 +1253,10 @@ def gcra_scan_ids20_acc(
 ):
     """gcra_scan_ids20 + expired-hit accumulation."""
     W = packed.shape[1]
+    if W % 5:
+        raise ValueError(
+            f"ids20 stream width must be a multiple of 5 (got {W})"
+        )
     B = W * 4 // 5
 
     def step(carry, kb):

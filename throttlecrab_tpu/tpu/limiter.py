@@ -26,6 +26,7 @@ Exactness notes vs the scalar oracle (core/rate_limiter.py):
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import numpy as np
 
@@ -75,7 +76,15 @@ def segment_info(slots, mask):
 
 @dataclass
 class BatchResult:
-    """Per-request outcomes of one batch (numpy arrays, length B)."""
+    """Per-request outcomes of one batch (numpy arrays, length B).
+
+    `cur_ns` (optional) is each request's exact observed TAT — new TAT
+    for allowed rows, effective TAT for denied rows — populated when the
+    launch rode the compact="cur" output tier with `collect_cur=True`.
+    The front tier's deny cache certifies entries from it; None
+    elsewhere (invalid lanes carry garbage: consumers must gate on
+    status).
+    """
 
     allowed: np.ndarray
     limit: np.ndarray
@@ -83,6 +92,7 @@ class BatchResult:
     reset_after_ns: np.ndarray
     retry_after_ns: np.ndarray
     status: np.ndarray
+    cur_ns: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -103,6 +113,9 @@ class WireBatchResult:
     reset_after_s: np.ndarray
     retry_after_s: np.ndarray
     status: np.ndarray
+    # Exact observed TATs when fetched through the cur tier with
+    # collect_cur=True (see BatchResult.cur_ns); None otherwise.
+    cur_ns: Optional[np.ndarray] = None
 
 
 # Segment arithmetic in the fast path multiplies inc by at most the
@@ -205,7 +218,8 @@ def limiter_uses_bytes_keys(limiter) -> bool:
     return bool(getattr(limiter, "_bytes_keys", False))
 
 
-def sequential_fallback(batches, decide_fn, error_result_fn, wire):
+def sequential_fallback(batches, decide_fn, error_result_fn, wire,
+                        **decide_kw):
     """Decide a rate_limit_many window batch-by-batch when the scan path
     cannot express it (a key changed parameters mid-batch — the multi-round
     sub-protocol interleaves with later sub-batches in ways one scan can't;
@@ -222,7 +236,7 @@ def sequential_fallback(batches, decide_fn, error_result_fn, wire):
             out.append(error_result_fn(len(b[0]), wire=wire))
             continue
         try:
-            out.append(decide_fn(*b, wire=wire))
+            out.append(decide_fn(*b, wire=wire, **decide_kw))
         except Exception:
             failed = True
             out.append(error_result_fn(len(b[0]), wire=wire))
@@ -330,6 +344,7 @@ class _PendingLaunch:
                 valid, now_ns, max_burst, status) in enumerate(
             self._prepared
         ):
+            cur_plane = None
             if self._w32:
                 # 4 B/request "w32" fetch: the device packed the exact
                 # wire values; unpack is shifts and masks.
@@ -342,6 +357,10 @@ class _PendingLaunch:
                         out[j, :n], emission, tolerance, quantity, now_ns
                     )
                 )
+                # The word is cur*2 + allowed; the arithmetic shift
+                # recovers the exact observed TAT (the deny cache's
+                # certification input — free on this tier).
+                cur_plane = out[j, :n] >> 1
             else:
                 o = out[j, :, :n]
             mask = self._valid_s[j, :n]
@@ -350,6 +369,7 @@ class _PendingLaunch:
                 limit=np.where(valid, max_burst, 0),
                 remaining=np.where(mask, o[1], 0),
                 status=status,
+                cur_ns=cur_plane,
             )
             if wire:
                 results.append(
@@ -400,10 +420,14 @@ class _PendingWireLaunch:
         for j, (packed, status, params) in enumerate(self._prepared):
             n = len(status)
             valid = (packed[:, 2] & 2) != 0
+            cur_plane = None
             if self._w32:
                 o = np.stack(finish_w32(out[j, :n]))
             elif self._finish is not None:
                 o = self._finish(packed, out[j, :n], self._now_ns).T
+                # cur*2 + allowed words: expose the exact observed TATs
+                # for the front tier's deny cache (see BatchResult).
+                cur_plane = out[j, :n] >> 1
             else:
                 o = out[j, :, :n]
             results.append(
@@ -414,6 +438,7 @@ class _PendingWireLaunch:
                     reset_after_s=np.where(valid, o[2], 0),
                     retry_after_s=np.where(valid, o[3], 0),
                     status=status,
+                    cur_ns=cur_plane,
                 )
             )
         return results
@@ -492,6 +517,7 @@ class TpuRateLimiter(ScalarCompatMixin):
         quantity,
         now_ns: int,
         wire: bool = False,
+        collect_cur: bool = False,
     ) -> BatchResult:
         """Decide a batch of requests at one server timestamp.
 
@@ -503,6 +529,12 @@ class TpuRateLimiter(ScalarCompatMixin):
         machinery compiled out whenever this batch provably has no
         quantity-0 / burst-1 / zero-emission / wrapped-negative-tolerance
         request (see has_degenerate).
+
+        `collect_cur=True` (wire mode only) rides the compact="cur"
+        output tier when its certificate holds, attaching each request's
+        exact observed TAT as `result.cur_ns` (what the front tier's
+        deny cache certifies entries from); cur_ns is None whenever cur
+        is uncertifiable.  Decisions are identical either way.
         """
         (n, max_burst, quantity, emission, tolerance, status, valid,
          slots, rank0, is_last0, rounds) = self._prepare_one(
@@ -513,6 +545,15 @@ class TpuRateLimiter(ScalarCompatMixin):
         from .kernel import cur_wire_safe
 
         params_cur_safe = cur_wire_safe(valid, tolerance, now_ns)
+        use_cur = (
+            wire
+            and collect_cur
+            and not degen
+            and params_cur_safe
+            and self.table.cur_safe
+        )
+        if use_cur:
+            from .kernel import finish_cur
 
         pad = max(self.MIN_PAD, 1 << (n - 1).bit_length())
         slots_p = np.zeros(pad, np.int32)
@@ -528,6 +569,7 @@ class TpuRateLimiter(ScalarCompatMixin):
         remaining = np.zeros(n, np.int64)
         reset_after = np.zeros(n, np.int64)
         retry_after = np.zeros(n, np.int64)
+        cur_plane = np.zeros(n, np.int64) if use_cur else None
 
         n_rounds = int(rounds.max()) + 1 if n else 1
         for r in range(n_rounds):
@@ -546,11 +588,21 @@ class TpuRateLimiter(ScalarCompatMixin):
                 rank, is_last = segment_info(slots_p, valid_p)
             out_dev = self.table.check_batch(
                 slots_p, rank, is_last, em_p, tol_p, q_p, valid_p, now_ns,
-                with_degen=with_degen, compact=wire,
+                with_degen=with_degen, compact="cur" if use_cur else wire,
                 params_cur_safe=params_cur_safe,
             )
             # One device→host fetch per round; rounds beyond 0 are rare.
-            out = np.asarray(out_dev)[:, :n]
+            if use_cur:
+                # cur*2 + allowed words: finish to the exact i32 wire
+                # planes on the host and keep the observed-TAT plane.
+                words = np.asarray(out_dev)[:n]
+                out = np.stack(
+                    finish_cur(words, emission, tolerance, quantity,
+                               now_ns)
+                )
+                cur_plane[mask] = (words >> 1)[mask]
+            else:
+                out = np.asarray(out_dev)[:, :n]
             allowed[mask] = out[0][mask] != 0
             remaining[mask] = out[1][mask]
             reset_after[mask] = out[2][mask]
@@ -565,6 +617,7 @@ class TpuRateLimiter(ScalarCompatMixin):
                 reset_after_s=reset_after,
                 retry_after_s=retry_after,
                 status=status,
+                cur_ns=cur_plane,
             )
         return BatchResult(
             allowed=allowed,
@@ -627,7 +680,9 @@ class TpuRateLimiter(ScalarCompatMixin):
             reset_after_ns=zeros, retry_after_ns=zeros, status=status,
         )
 
-    def rate_limit_many(self, batches, wire: bool = False) -> list:
+    def rate_limit_many(
+        self, batches, wire: bool = False, collect_cur: bool = False
+    ) -> list:
         """Decide K whole batches in ONE device launch (gcra_scan).
 
         `batches` is a list of (keys, max_burst, count_per_period, period,
@@ -641,12 +696,24 @@ class TpuRateLimiter(ScalarCompatMixin):
         rounds > 0) fall back to the per-batch path, preserving exact
         ordering; that case is rare in serving traffic.
         """
-        return self.dispatch_many(batches, wire=wire).fetch()
+        return self.dispatch_many(
+            batches, wire=wire, collect_cur=collect_cur
+        ).fetch()
 
-    def dispatch_many(self, batches, wire: bool = False):
+    def dispatch_many(
+        self, batches, wire: bool = False, collect_cur: bool = False
+    ):
         """The dispatch half of rate_limit_many: host-prepare the window,
         launch it on the device, and return a handle whose `.fetch()`
         blocks for the results.
+
+        `collect_cur=True` (wire mode only) asks for the exact observed
+        TATs alongside the wire values: the dispatcher prefers the cur
+        output tier over w32 (8 B/request instead of 4 — the TAT plane
+        is what the front tier's deny cache certifies entries from) and
+        attaches it as `result.cur_ns`.  Falls back to the 4-plane tier
+        with cur_ns=None whenever cur is uncertifiable; decisions are
+        identical either way.
 
         Device dispatch is asynchronous, so the caller can assemble and
         dispatch window N+1 while the device executes window N and only
@@ -673,6 +740,7 @@ class TpuRateLimiter(ScalarCompatMixin):
                     sequential_fallback(
                         batches, self.rate_limit_batch,
                         self._error_result, wire,
+                        collect_cur=collect_cur,
                     )
                 )
             any_degen = any_degen or has_degenerate(
@@ -732,6 +800,7 @@ class TpuRateLimiter(ScalarCompatMixin):
         # the window and no earlier than any prior launch's.
         use_w32 = (
             wire
+            and not collect_cur
             and not any_degen
             and now_max < (1 << 61)
             and bool((np.diff(now_s) >= 0).all())
@@ -760,7 +829,9 @@ class TpuRateLimiter(ScalarCompatMixin):
 
     # ------------------------------------------------------------------ #
 
-    def dispatch_wire_window(self, frames, now_ns: int):
+    def dispatch_wire_window(
+        self, frames, now_ns: int, collect_cur: bool = False
+    ):
         """The fully-native serving dispatch: each frame is
         (key_blob, offsets i64[n+1], params i64[n, 4]) exactly as the C++
         wire layer hands batches over.  One C++ call per frame validates,
@@ -844,7 +915,9 @@ class TpuRateLimiter(ScalarCompatMixin):
         # over the rows, and the halved fetch repays the bookkeeping
         # many times over on the tunnel.
         use_w32 = False
-        if not any_degen and not any_bigtol:
+        if not any_degen and not any_bigtol and not collect_cur:
+            # collect_cur: the caller (a front-tier serving loop) wants
+            # the observed-TAT plane, which only the cur tier carries.
             from .kernel import fits_w32_wire_agg
 
             use_w32 = fits_w32_wire_agg(

@@ -157,11 +157,15 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
     return len(keys)
 
 
-def load_snapshot(limiter, path: Union[str, Path], now_ns: int) -> int:
+def load_snapshot(
+    limiter, path: Union[str, Path], now_ns: int, front=None
+) -> int:
     """Restore a snapshot into a fresh limiter; returns #keys restored.
 
     `now_ns` gates restoration: entries already expired are skipped (the
     TTL contract holds across restarts).  The limiter must be empty.
+    `front` (an optional front.FrontTier) is fully invalidated — the
+    restore rewrites bucket state out from under any cached denials.
 
     Shard topology is NOT part of the contract: a snapshot taken on D
     shards restores onto any shard count (including a single-device
@@ -173,8 +177,10 @@ def load_snapshot(limiter, path: Union[str, Path], now_ns: int) -> int:
 
     local = getattr(limiter, "local", None)
     if local is not None:  # ClusterLimiter
-        return load_snapshot(local, path, now_ns)
+        return load_snapshot(local, path, now_ns, front=front)
 
+    if front is not None:
+        front.on_restore()
     if len(limiter) != 0:
         raise ValueError("restore requires an empty limiter")
     path = _normalize(path)
@@ -260,12 +266,23 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
     # recoverable as expiry - tat (kernel _finish: expiry = tat + tol,
     # saturated to i64max for never-expires — which correctly saturates
     # the mark and disables w32).
+    tat_arr = np.asarray(tats, np.int64)
+    exp_arr = np.asarray(expiries, np.int64)
     note = getattr(limiter.table, "note_max_tolerance", None)
     if note is not None:
-        restored_tol = max(
-            (e - t for t, e in zip(tats, expiries)), default=0
-        )
-        note(restored_tol if restored_tol < (1 << 62) else None)
+        # expiry - tat can wrap i64 for pathological foreign entries
+        # (negative tat with I64_MAX expiry); probe the difference in
+        # f64 first (no wrap, error <= ~2^11 ns at i64 magnitudes) and
+        # saturate anything at or beyond 2^61 — note(None) disables w32,
+        # so over-saturating near the boundary is always safe.  The
+        # surviving lanes are < 2^61 + rounding, so the int64 subtract
+        # below cannot wrap.  All numpy, no per-element Python.
+        diff_f = exp_arr.astype(np.float64) - tat_arr.astype(np.float64)
+        sat = (exp_arr >= (1 << 62)) | (diff_f >= float(1 << 61))
+        if bool(sat.any()):
+            note(None)
+        else:
+            note(int((exp_arr - tat_arr).max(initial=0)))
     # The restored TATs also embed the WRITER's clock: tat <= writer_now
     # + tol, and a reader whose clock lags the writer would pass the w32
     # certificate while reset/retry overflow their fields.  Seeding
@@ -274,7 +291,7 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
     # stays off exactly until the reader's clock catches up.
     note_now = getattr(limiter.table, "note_launch_now", None)
     if note_now is not None:
-        restored_tat = max(tats, default=0)
+        restored_tat = int(tat_arr.max(initial=0))
         note_now(restored_tat if restored_tat < (1 << 62) else None)
 
     if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
