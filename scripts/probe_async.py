@@ -24,8 +24,6 @@ if "--cpu" in sys.argv:
     # Env var alone is not enough: the accelerator plugin in
     # sitecustomize re-points JAX after the environment is read.
     jax.config.update("jax_platforms", "cpu")
-import jax.numpy as jnp
-
 from throttlecrab_tpu.tpu.kernel import gcra_scan
 from throttlecrab_tpu.tpu.table import BucketTable
 

@@ -32,7 +32,6 @@ import jax.numpy as jnp
 
 from throttlecrab_tpu.tpu.kernel import (
     EMPTY_EXPIRY,
-    IDROW_WIDTH,
     _U32,
     _gcra_body,
     pack_id_rows,

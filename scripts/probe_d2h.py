@@ -46,7 +46,7 @@ def fresh_outputs(n, mb):
 
 def timed(label, fn, outs):
     t0 = time.perf_counter()
-    res = fn(outs)
+    fn(outs)
     dt = time.perf_counter() - t0
     total_mb = sum(o.size * o.dtype.itemsize for o in outs) / 1e6
     print(

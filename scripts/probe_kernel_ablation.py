@@ -32,7 +32,7 @@ from throttlecrab_tpu.tpu.kernel import (
     sat_add,
     sat_sub,
 )
-from throttlecrab_tpu.tpu.sat import sat_mul_nonneg, div_trunc
+from throttlecrab_tpu.tpu.sat import div_trunc
 
 dev = jax.devices()[0]
 print(f"device: {dev}", file=sys.stderr, flush=True)

@@ -105,7 +105,6 @@ table = BucketTable(CAP)
 
 
 def f_row():
-    nonlocal_state = table
     table.state, out = gcra_scan_packed(
         table.state, jnp.asarray(pk_row), jnp.asarray(now),
         with_degen=False, compact=True,

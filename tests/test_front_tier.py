@@ -681,6 +681,21 @@ def test_deny_cache_capacity_bound():
     assert len(cache._records) <= 4
 
 
+def test_deny_cache_record_refresh_defers_eviction():
+    """Write-record eviction is FIFO by LAST write, not first insert:
+    a hot key refreshed moments ago must outlive cold-tail churn."""
+    cache = DenyCache(2)
+    _prime(cache, key="hot")
+    _prime(cache, key="cold1")
+    # Refresh the hot key's write record (a new allowed observation).
+    cache.observe("hot", 3, 60, 60, 1, T0 + NS, True, seq=10,
+                  cur_ns=T0 + 3 * NS)
+    # Cold churn evicts ONE record: it must be cold1, not hot.
+    _prime(cache, key="cold2")
+    assert "hot" in cache._records
+    assert "cold1" not in cache._records
+
+
 def test_deny_cache_sweep_drops_expired():
     cache = DenyCache(64)
     em, tol, inc, tat = _prime(cache)

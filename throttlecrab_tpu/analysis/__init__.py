@@ -1,0 +1,70 @@
+"""Static invariant analysis for the throttlecrab-tpu tree.
+
+Every high-severity bug the advisor rounds have surfaced so far was one
+of two hand-maintained invariants silently breaking: raw numpy int64
+arithmetic on TAT/tolerance values escaping the saturating helpers
+(core/i64.py, tpu/sat.py), or the Python kernel drifting from its C++
+twin (native/keymap.cpp, native/wire_server.cpp).  This package checks
+those invariants mechanically, on every PR, in seconds:
+
+  * ``i64_hygiene``  — raw ``+``/``-``/``*`` on int64 TAT/tolerance/
+    expiry expressions in hot-path modules that are neither routed
+    through the saturating helpers nor dominated by an explicit
+    ``>= 2**61`` refusal guard (the exact class of the round-5
+    ``fits_w32_wire`` wrap);
+  * ``twin_drift``   — wire constants, status codes, prep flags, error
+    strings and the 2^61/2^62 certificates extracted from BOTH the
+    Python kernel and the C++ twins, failing on any divergence;
+  * ``jit_boundary`` — Python ``if``/``while``/``assert`` on traced
+    values and host calls (``time.*``, ``np.random``, I/O) inside
+    ``@jax.jit``/Pallas-decorated functions;
+  * ``registry``     — every ``THROTTLECRAB_*`` knob the package reads
+    must be documented (README/ARCHITECTURE), and every
+    ``throttlecrab_*`` metric emitted must match the
+    ``server/metrics.py`` METRIC_NAMES registry (both directions).
+
+Pure stdlib, AST-based plus a small C++ token scanner: importing this
+package (or running ``scripts/check_invariants.py``) must never import
+jax, numpy, or the package under analysis — sources are parsed, not
+executed.  Audited pre-existing exceptions live in ``baseline.toml``
+next to this file; the suite ratchets from zero unwaived findings.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List
+
+from .common import Finding, apply_baseline, load_baseline
+from . import i64_hygiene, jit_boundary, registry, twin_drift
+
+#: name -> check(root) callables, in report order.
+CHECKERS = {
+    "i64": i64_hygiene.check,
+    "twin": twin_drift.check,
+    "jit": jit_boundary.check,
+    "registry": registry.check,
+}
+
+DEFAULT_BASELINE = Path(__file__).with_name("baseline.toml")
+
+
+def run_all(root, checks=None) -> List[Finding]:
+    """Run the selected checkers (default: all) over a repo tree."""
+    root = Path(root)
+    findings: List[Finding] = []
+    for name, fn in CHECKERS.items():
+        if checks is None or name in checks:
+            findings.extend(fn(root))
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+__all__ = [
+    "CHECKERS",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "apply_baseline",
+    "load_baseline",
+    "run_all",
+]

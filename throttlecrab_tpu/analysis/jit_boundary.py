@@ -1,0 +1,310 @@
+"""jit/Pallas boundary purity: no Python control flow on traced values,
+no host calls inside compiled functions.
+
+Inside a function compiled by ``jax.jit`` (or lowered by
+``pl.pallas_call``) every non-static argument is a tracer: a Python
+``if``/``while``/``assert`` on one raises at best (ConcretizationError)
+and silently freezes a trace-time value at worst; ``time.*``,
+``np.random``, and I/O execute once at trace time and never again —
+classic cache-keyed heisenbugs.
+
+The checker finds compiled functions statically:
+
+  * defs decorated with ``jax.jit`` / ``jit`` / ``partial(jax.jit, …)``
+    / ``functools.partial(jax.jit, …)`` / ``jax.pmap``;
+  * defs referenced by name as the first argument of a
+    ``pl.pallas_call(…)`` in the same module (Pallas kernel bodies).
+
+Within each, a forward pass classifies locals: parameters are traced
+except names listed in ``static_argnames``; a local assigned purely
+from static expressions (shapes, dtypes, constants, other statics)
+stays static; anything touched by a traced name becomes traced.
+``if``/``while``/``assert`` on a traced name is ``jit-branch``; calls
+into host modules (``time``, ``random``, ``np.random``, ``os``,
+``socket``, ``open``/``input``/``print``) are ``jit-host-call``.
+Nested defs (scan/loop bodies) are scanned with their parameters
+traced and the enclosing environment visible to closures.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional, Sequence, Set
+
+from .common import (
+    Finding,
+    PyModule,
+    attached_exprs,
+    child_stmt_lists,
+    dotted_name,
+    iter_py_files,
+    pragma_codes,
+)
+
+BRANCH = "jit-branch"
+HOST = "jit-host-call"
+
+SCAN_DIR = "throttlecrab_tpu"
+
+#: Attribute-chain roots that mean host-side effects at trace time.
+_HOST_ROOTS = {"time", "random", "os", "sys", "socket", "subprocess"}
+_HOST_CHAINS = {"np.random", "numpy.random"}
+_HOST_BARE = {"open", "input", "print"}
+
+#: Attributes whose access on a tracer yields a static (Python) value.
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+
+def _decorator_jit_info(dec: ast.expr) -> Optional[Set[str]]:
+    """If this decorator compiles the function, return its
+    static_argnames set; else None."""
+    name = dotted_name(dec)
+    if name in ("jax.jit", "jit", "jax.pmap"):
+        return set()
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn in ("jax.jit", "jit", "jax.pmap"):
+            return _static_argnames(dec)
+        if fn in ("partial", "functools.partial") and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner in ("jax.jit", "jit", "jax.pmap"):
+                return _static_argnames(dec)
+    return None
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    out.add(node.value)
+    return out
+
+
+def _pallas_kernel_names(tree: ast.Module) -> Set[str]:
+    """Function names passed (by name) as pallas_call's kernel arg."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn and fn.split(".")[-1] == "pallas_call" and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    out.add(first.id)
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class _TraceEnv:
+    """Name classification inside one compiled function."""
+
+    def __init__(self, traced: Set[str], static: Set[str]) -> None:
+        self.traced = set(traced)
+        self.static = set(static)
+
+    def expr_is_traced(self, node: ast.expr) -> bool:
+        """Does evaluating this expression touch a traced value in a
+        way that yields a tracer (shape/dtype reads are static)?"""
+        return bool(self._traced_names(node))
+
+    def _traced_names(self, node: ast.expr) -> Set[str]:
+        out: Set[str] = set()
+        for sub in _walk_value_positions(node):
+            if isinstance(sub, ast.Name) and sub.id in self.traced:
+                out.add(sub.id)
+        return out
+
+    def observe(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                if self.expr_is_traced(stmt.value):
+                    self.traced.add(stmt.target.id)
+                    self.static.discard(stmt.target.id)
+            return
+        else:
+            return
+        traced = self.expr_is_traced(value)
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    if traced:
+                        self.traced.add(sub.id)
+                        self.static.discard(sub.id)
+                    else:
+                        self.static.add(sub.id)
+                        self.traced.discard(sub.id)
+
+
+def _walk_value_positions(node: ast.expr):
+    """Walk an expression, pruning subtrees that read only static
+    metadata (``x.shape``, ``x.dtype[...]`` …) — their result is a
+    plain Python value even when ``x`` is traced."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Attribute) and cur.attr in _STATIC_ATTRS:
+            continue
+        if (
+            isinstance(cur, ast.Subscript)
+            and isinstance(cur.value, ast.Attribute)
+            and cur.value.attr in _STATIC_ATTRS
+        ):
+            continue
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _host_call_name(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    if name in _HOST_BARE:
+        return name
+    root = name.split(".")[0]
+    if root in _HOST_ROOTS:
+        return name
+    for chain in _HOST_CHAINS:
+        if name == chain or name.startswith(chain + "."):
+            return name
+    return None
+
+
+def _scan_compiled(
+    mod: PyModule,
+    fn: ast.FunctionDef,
+    static_names: Set[str],
+    findings: List[Finding],
+    outer: Optional[_TraceEnv] = None,
+) -> None:
+    params = _param_names(fn)
+    env = _TraceEnv(
+        traced={p for p in params if p not in static_names},
+        static=set(static_names),
+    )
+    if outer is not None:
+        # Closure visibility: enclosing statics stay static unless the
+        # nested def shadows them with a (traced) parameter.
+        env.static |= outer.static - env.traced
+        env.traced |= outer.traced - env.static
+
+    def visit(stmts: Sequence[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_compiled(mod, stmt, set(), findings, outer=env)
+                continue
+            if isinstance(stmt, ast.For):
+                # A loop variable bound from a traced iterable is a
+                # tracer; from a static one (range, shape tuples) it
+                # stays static.  Classify before scanning the body so
+                # `if v > 0:` on a traced `v` is caught.
+                traced_iter = env.expr_is_traced(stmt.iter)
+                for sub in ast.walk(stmt.target):
+                    if isinstance(sub, ast.Name):
+                        if traced_iter:
+                            env.traced.add(sub.id)
+                            env.static.discard(sub.id)
+                        else:
+                            env.static.add(sub.id)
+                            env.traced.discard(sub.id)
+            test: Optional[ast.expr] = None
+            if isinstance(stmt, (ast.If, ast.While)):
+                test = stmt.test
+            elif isinstance(stmt, ast.Assert):
+                test = stmt.test
+            if test is not None and env.expr_is_traced(test):
+                kind = type(stmt).__name__.lower()
+                if BRANCH not in pragma_codes(mod.lines, stmt.lineno):
+                    names = sorted(env._traced_names(test))
+                    findings.append(
+                        Finding(
+                            code=BRANCH,
+                            path=mod.rel,
+                            line=stmt.lineno,
+                            symbol=mod.qualname(stmt),
+                            message=(
+                                f"Python `{kind}` on traced value(s) "
+                                f"{', '.join(names)} inside a "
+                                "jit/Pallas-compiled function — use "
+                                "jnp.where/lax.cond or move the check "
+                                "to the host certificate"
+                            ),
+                        )
+                    )
+            for expr in attached_exprs(stmt):
+                for sub in ast.walk(expr):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    host = _host_call_name(sub)
+                    if host is not None and HOST not in pragma_codes(
+                        mod.lines, sub.lineno
+                    ):
+                        findings.append(
+                            Finding(
+                                code=HOST,
+                                path=mod.rel,
+                                line=sub.lineno,
+                                symbol=mod.qualname(sub),
+                                message=(
+                                    f"host call `{host}` inside a "
+                                    "jit/Pallas-compiled function "
+                                    "executes once at trace time, not "
+                                    "per launch"
+                                ),
+                            )
+                        )
+            env.observe(stmt)
+            for block in child_stmt_lists(stmt):
+                visit(block)
+
+    visit(fn.body)
+
+
+def _check_module(mod: PyModule) -> List[Finding]:
+    findings: List[Finding] = []
+    pallas_kernels = _pallas_kernel_names(mod.tree)
+    seen: Set[int] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.FunctionDef) or id(node) in seen:
+            continue
+        static: Optional[Set[str]] = None
+        for dec in node.decorator_list:
+            info = _decorator_jit_info(dec)
+            if info is not None:
+                static = info
+                break
+        if static is None and node.name in pallas_kernels:
+            static = set()
+        if static is None:
+            continue
+        seen.add(id(node))
+        _scan_compiled(mod, node, static, findings)
+    return findings
+
+
+def check(root) -> List[Finding]:
+    root = Path(root)
+    findings: List[Finding] = []
+    for rel in iter_py_files(root, SCAN_DIR):
+        try:
+            mod = PyModule.load(root, rel)
+        except (OSError, SyntaxError):
+            continue
+        findings.extend(_check_module(mod))
+    return findings

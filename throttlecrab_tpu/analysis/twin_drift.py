@@ -1,0 +1,522 @@
+"""Python ↔ C++ twin parity: constants, status codes, guards, strings.
+
+The Python kernel (``tpu/kernel.py``/``tpu/limiter.py``) and the C++
+hot paths (``native/keymap.cpp``, ``native/wire_server.cpp``) implement
+the same wire contracts twice; nothing at runtime checks they agree.
+This checker extracts both sides — Python via AST constant folding, C++
+via a small ``constexpr`` token scanner — and fails on any divergence:
+
+  * packed-row layout (``PACK_WIDTH`` vs ``PACK_W``), prep flag bits
+    (``PREP_*`` vs ``TK_PREP_*``), per-request status codes
+    (``STATUS_*``), RESP frame limits (``MAX_BULK``/``MAX_ARRAY``);
+  * the 2^61 big-tolerance refusal guards the wire certificates hang on
+    (``fits_*`` in kernel.py vs ``TK_PREP_BIGTOL`` in tk_prepare_batch)
+    — per *identifier*, so dropping just the ``tol`` guard from
+    ``fits_w32_wire`` (the round-5 high finding) is caught even while
+    the function's other 2^61 compares survive;
+  * the 2^62 segment-arithmetic certificate (``_MUL_SAFE`` /
+    ``MAX_SEGMENT`` vs tk_prepare_batch's float literals);
+  * the status→error-string taxonomy (engine ``STATUS_MESSAGES`` +
+    admission ``OVERLOAD_MESSAGE`` vs the C++ wire payloads, and the
+    set of status codes the C++ responder branches on).
+
+Finding codes: ``twin-drift`` (values differ), ``twin-missing`` (one
+side could not be extracted — extraction failure is drift of the
+anchor, never a silent pass), ``twin-guard-missing`` (a required 2^61
+guard identifier is gone).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import Finding, PyModule, fold_int
+from .i64_hygiene import GUARD_MIN, refusal_guards
+
+DRIFT = "twin-drift"
+MISSING = "twin-missing"
+GUARD = "twin-guard-missing"
+
+KERNEL = "throttlecrab_tpu/tpu/kernel.py"
+LIMITER = "throttlecrab_tpu/tpu/limiter.py"
+NATIVE_PY = "throttlecrab_tpu/native.py"
+RESP = "throttlecrab_tpu/server/resp.py"
+ADMISSION = "throttlecrab_tpu/front/admission.py"
+ENGINE = "throttlecrab_tpu/server/engine.py"
+TABLE = "throttlecrab_tpu/tpu/table.py"
+KEYMAP_CPP = "native/keymap.cpp"
+WIRE_CPP = "native/wire_server.cpp"
+
+#: (python_file, python_const, cpp_file, cpp_const) integer pairs that
+#: must be equal.  Python consts may be class-scoped ("Cls.NAME").
+CONST_PAIRS: Tuple[Tuple[str, str, str, str], ...] = (
+    (KERNEL, "PACK_WIDTH", KEYMAP_CPP, "PACK_W"),
+    (NATIVE_PY, "PREP_DEGEN", KEYMAP_CPP, "TK_PREP_DEGEN"),
+    (NATIVE_PY, "PREP_CONFLICT", KEYMAP_CPP, "TK_PREP_CONFLICT"),
+    (NATIVE_PY, "PREP_FULL", KEYMAP_CPP, "TK_PREP_FULL"),
+    (NATIVE_PY, "PREP_BIGTOL", KEYMAP_CPP, "TK_PREP_BIGTOL"),
+    (LIMITER, "STATUS_OK", KEYMAP_CPP, "STATUS_OK"),
+    (
+        LIMITER,
+        "STATUS_NEGATIVE_QUANTITY",
+        KEYMAP_CPP,
+        "STATUS_NEGATIVE_QUANTITY",
+    ),
+    (
+        LIMITER,
+        "STATUS_INVALID_PARAMS",
+        KEYMAP_CPP,
+        "STATUS_INVALID_PARAMS",
+    ),
+    (RESP, "MAX_BULK_STRING_SIZE", WIRE_CPP, "MAX_BULK"),
+    (RESP, "MAX_ARRAY_SIZE", WIRE_CPP, "MAX_ARRAY"),
+)
+
+#: kernel.py wire-certificate functions → identifiers that must each be
+#: dominated by an explicit >= 2^61 comparison inside the function.
+#: ``tol`` in fits_w32_wire is THE round-5 regression: its absence
+#: falsely certified w32 for big-tolerance lanes while the C++ twin
+#: (TK_PREP_BIGTOL) refused them.
+GUARD_MANIFEST: Dict[str, Set[str]] = {
+    "fits_cur_wire": {"now_ns", "tolerance"},
+    "fits_w32_wire": {"now_ns", "hwm", "tol"},
+    "fits_w32_wire_agg": {"now_ns", "hwm"},
+    "cur_wire_safe": {"now_ns", "tolerance"},
+}
+
+#: C++ functions that must contain a << 61 guard expression.
+CPP_GUARD_FUNCS = ("tk_prepare_batch",)
+
+#: Python status code name (module, const) → the C++ responder must
+#: branch on its value (``status[i] == N``) and carry the message.
+STATUS_BRANCHES: Tuple[Tuple[str, str], ...] = (
+    (LIMITER, "STATUS_NEGATIVE_QUANTITY"),
+    (LIMITER, "STATUS_INVALID_PARAMS"),
+    (ADMISSION, "STATUS_OVERLOADED"),
+)
+
+
+# ----------------------------------------------------------------- #
+# Python-side extraction
+
+
+def _py_consts(mod: PyModule) -> Dict[str, int]:
+    """Module- and class-level integer constant assignments, folded."""
+    out: Dict[str, int] = {}
+
+    def scan(body, prefix: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                scan(stmt.body, prefix + stmt.name + ".")
+            elif isinstance(stmt, ast.Assign):
+                v = fold_int(stmt.value)
+                if v is None:
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out[prefix + t.id] = v
+
+    scan(mod.tree.body, "")
+    return out
+
+
+def _py_functions(mod: PyModule) -> Dict[str, ast.FunctionDef]:
+    return {
+        node.name: node
+        for node in ast.walk(mod.tree)
+        if isinstance(node, ast.FunctionDef)
+    }
+
+
+def _py_string_map(mod: PyModule, dict_name: str) -> Dict[str, str]:
+    """A module-level ``NAME = {CONST_NAME: "string", ...}`` mapping,
+    keyed by the key's source name."""
+    for stmt in mod.tree.body:
+        if not (
+            isinstance(stmt, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == dict_name
+                for t in stmt.targets
+            )
+            and isinstance(stmt.value, ast.Dict)
+        ):
+            continue
+        out: Dict[str, str] = {}
+        for k, v in zip(stmt.value.keys, stmt.value.values):
+            if (
+                isinstance(k, ast.Name)
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)
+            ):
+                out[k.id] = v.value
+        return out
+    return {}
+
+
+def _py_str_const(mod: PyModule, name: str) -> Optional[str]:
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name
+            for t in stmt.targets
+        ):
+            if isinstance(stmt.value, ast.Constant) and isinstance(
+                stmt.value.value, str
+            ):
+                return stmt.value.value
+    return None
+
+
+# ----------------------------------------------------------------- #
+# C++-side extraction (token scan, not a parser)
+
+_CPP_CONSTEXPR = re.compile(
+    r"constexpr\s+(?:[A-Za-z_][\w:]*\s+)+?(\w+)\s*=\s*([^;]+);"
+)
+_CPP_INT_TOKEN = re.compile(r"^\d+$")
+
+
+def _strip_cpp_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _join_adjacent_strings(text: str) -> str:
+    """Collapse C++ adjacent string-literal concatenation ("a" "b",
+    possibly across lines) so message substrings can be searched."""
+    return re.sub(r'"\s*\n\s*"', "", text)
+
+
+def _eval_cpp_int(expr: str) -> Optional[int]:
+    """Evaluate a simple C++ integer constant expression: literals with
+    LL/ULL suffixes, ``*`` products, ``<<`` shifts, ``int64_t(1)``
+    style casts, parentheses."""
+    expr = expr.strip()
+    expr = re.sub(r"(?<=\d)[uU]?[lL]{1,2}\b", "", expr)
+    expr = re.sub(r"\b(?:int64_t|uint64_t|int32_t|size_t)\s*\(", "(", expr)
+    expr = re.sub(r"'\s*", "", expr)  # digit separators
+    if not re.fullmatch(r"[\d\s()*+<-]+", expr):
+        return None
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError:
+        return None
+    return fold_int(tree.body)
+
+
+def _cpp_consts(text: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for m in _CPP_CONSTEXPR.finditer(text):
+        v = _eval_cpp_int(m.group(2))
+        if v is not None:
+            out[m.group(1)] = v
+    return out
+
+
+def _cpp_function_span(text: str, name: str) -> Optional[str]:
+    """Source text of one function body, by brace matching from the
+    first ``name(...)  {`` definition."""
+    m = re.search(rf"\b{re.escape(name)}\s*\(", text)
+    if m is None:
+        return None
+    brace = text.find("{", m.end())
+    if brace < 0:
+        return None
+    depth = 0
+    for i in range(brace, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[m.start() : i + 1]
+    return None
+
+
+def _line_of(text: str, needle: str) -> int:
+    idx = text.find(needle)
+    return text.count("\n", 0, idx) + 1 if idx >= 0 else 1
+
+
+# ----------------------------------------------------------------- #
+
+
+def check(root) -> List[Finding]:
+    root = Path(root)
+    findings: List[Finding] = []
+
+    mods: Dict[str, Optional[PyModule]] = {}
+    for rel in (KERNEL, LIMITER, NATIVE_PY, RESP, ADMISSION, ENGINE):
+        try:
+            mods[rel] = PyModule.load(root, rel)
+        except OSError:
+            mods[rel] = None
+            findings.append(
+                Finding(MISSING, rel, 1, "twin anchor file unreadable")
+            )
+
+    cpp_raw: Dict[str, Optional[str]] = {}
+    for rel in (KEYMAP_CPP, WIRE_CPP):
+        path = root / rel
+        if path.exists():
+            cpp_raw[rel] = path.read_text()
+        else:
+            cpp_raw[rel] = None
+            findings.append(
+                Finding(MISSING, rel, 1, "twin anchor file unreadable")
+            )
+
+    cpp_clean = {
+        rel: _strip_cpp_comments(text) if text is not None else None
+        for rel, text in cpp_raw.items()
+    }
+    cpp_consts = {
+        rel: _cpp_consts(text) if text is not None else {}
+        for rel, text in cpp_clean.items()
+    }
+    py_consts = {
+        rel: _py_consts(mod) if mod is not None else {}
+        for rel, mod in mods.items()
+    }
+
+    # ---- integer constant pairs ---------------------------------- #
+    for py_rel, py_name, cpp_rel, cpp_name in CONST_PAIRS:
+        pv = py_consts.get(py_rel, {}).get(py_name)
+        cv = cpp_consts.get(cpp_rel, {}).get(cpp_name)
+        if pv is None and mods.get(py_rel) is not None:
+            findings.append(
+                Finding(
+                    MISSING,
+                    py_rel,
+                    1,
+                    f"expected constant {py_name} not extractable "
+                    f"(twin of {cpp_rel}:{cpp_name})",
+                )
+            )
+        if cv is None and cpp_clean.get(cpp_rel) is not None:
+            findings.append(
+                Finding(
+                    MISSING,
+                    cpp_rel,
+                    1,
+                    f"expected constant {cpp_name} not extractable "
+                    f"(twin of {py_rel}:{py_name})",
+                )
+            )
+        if pv is not None and cv is not None and pv != cv:
+            findings.append(
+                Finding(
+                    DRIFT,
+                    py_rel,
+                    1,
+                    f"{py_name} = {pv} but C++ twin "
+                    f"{cpp_rel}:{cpp_name} = {cv}",
+                )
+            )
+
+    # ---- 2^61 guard manifest (kernel.py) ------------------------- #
+    kernel = mods.get(KERNEL)
+    if kernel is not None:
+        fns = _py_functions(kernel)
+        for fn_name, required in GUARD_MANIFEST.items():
+            fn = fns.get(fn_name)
+            if fn is None:
+                findings.append(
+                    Finding(
+                        MISSING,
+                        KERNEL,
+                        1,
+                        f"wire-certificate function {fn_name} not "
+                        "found (guard manifest anchor)",
+                    )
+                )
+                continue
+            guarded = refusal_guards(fn)
+            for ident in sorted(required - guarded):
+                findings.append(
+                    Finding(
+                        GUARD,
+                        KERNEL,
+                        fn.lineno,
+                        symbol=fn_name,
+                        message=(
+                            f"{fn_name} lost its >= 2**61 refusal "
+                            f"guard on `{ident}` — the C++ twin "
+                            "(TK_PREP_BIGTOL, native/keymap.cpp) "
+                            "refuses such lanes before any arithmetic "
+                            "can wrap (ADVICE round 5 high finding)"
+                        ),
+                    )
+                )
+
+    # ---- 2^61 guard presence (C++) ------------------------------- #
+    keymap_text = cpp_clean.get(KEYMAP_CPP)
+    if keymap_text is not None:
+        for fn_name in CPP_GUARD_FUNCS:
+            span = _cpp_function_span(keymap_text, fn_name)
+            if span is None:
+                findings.append(
+                    Finding(
+                        MISSING,
+                        KEYMAP_CPP,
+                        1,
+                        f"function {fn_name} not found (guard anchor)",
+                    )
+                )
+            elif not re.search(r"<<\s*61\b", span):
+                findings.append(
+                    Finding(
+                        GUARD,
+                        KEYMAP_CPP,
+                        _line_of(cpp_raw[KEYMAP_CPP] or "", fn_name),
+                        symbol=fn_name,
+                        message=(
+                            f"{fn_name} lost its 1 << 61 big-tolerance "
+                            "guard (twin of kernel.py fits_* "
+                            "certificates)"
+                        ),
+                    )
+                )
+
+    # ---- 2^62 segment-arithmetic certificate --------------------- #
+    limiter = mods.get(LIMITER)
+    if limiter is not None and keymap_text is not None:
+        mul_safe = py_consts[LIMITER].get("_MUL_SAFE")
+        if mul_safe != GUARD_MIN * 2:
+            findings.append(
+                Finding(
+                    DRIFT,
+                    LIMITER,
+                    1,
+                    f"_MUL_SAFE = {mul_safe} != 2**62 — the certified "
+                    "plain-multiply bound the kernel and "
+                    "tk_prepare_batch both assume",
+                )
+            )
+        span = _cpp_function_span(keymap_text, "tk_prepare_batch") or ""
+        if "4611686018427387904.0" not in span:
+            findings.append(
+                Finding(
+                    GUARD,
+                    KEYMAP_CPP,
+                    _line_of(cpp_raw[KEYMAP_CPP] or "", "tk_prepare_batch"),
+                    symbol="tk_prepare_batch",
+                    message=(
+                        "tk_prepare_batch lost the 2**62 segment-"
+                        "arithmetic certificate (limiter._MUL_SAFE "
+                        "twin)"
+                    ),
+                )
+            )
+        # MAX_SEGMENT: limiter binds it to BucketTable.SCRATCH; the C++
+        # certificate hard-codes the float.  Extract SCRATCH from
+        # table.py and require the literal to match.
+        try:
+            table = PyModule.load(root, TABLE)
+            scratch = _py_consts(table).get("BucketTable.SCRATCH")
+        except OSError:
+            scratch = None
+        if scratch is None:
+            findings.append(
+                Finding(
+                    MISSING,
+                    TABLE,
+                    1,
+                    "BucketTable.SCRATCH not extractable (MAX_SEGMENT "
+                    "twin anchor)",
+                )
+            )
+        elif f"{float(scratch):.1f}" not in span:
+            findings.append(
+                Finding(
+                    DRIFT,
+                    KEYMAP_CPP,
+                    _line_of(cpp_raw[KEYMAP_CPP] or "", "tk_prepare_batch"),
+                    symbol="tk_prepare_batch",
+                    message=(
+                        f"MAX_SEGMENT is {scratch} "
+                        f"(BucketTable.SCRATCH) but tk_prepare_batch's "
+                        f"certificate does not use {float(scratch):.1f}"
+                    ),
+                )
+            )
+
+    # ---- status codes the C++ responder branches on -------------- #
+    wire_text = cpp_clean.get(WIRE_CPP)
+    if wire_text is not None:
+        handled = {
+            int(m.group(1))
+            for m in re.finditer(r"status\[i\]\s*==\s*(\d+)", wire_text)
+        }
+        for mod_rel, const in STATUS_BRANCHES:
+            mod = mods.get(mod_rel)
+            if mod is None:
+                continue
+            value = _py_consts(mod).get(const)
+            if value is None:
+                findings.append(
+                    Finding(
+                        MISSING,
+                        mod_rel,
+                        1,
+                        f"status constant {const} not extractable",
+                    )
+                )
+            elif value not in handled:
+                findings.append(
+                    Finding(
+                        DRIFT,
+                        WIRE_CPP,
+                        1,
+                        f"ws_respond does not branch on status "
+                        f"{const} = {value} ({mod_rel}); C++ clients "
+                        "would get the generic internal error",
+                    )
+                )
+
+    # ---- error-string taxonomy ----------------------------------- #
+    engine = mods.get(ENGINE)
+    admission = mods.get(ADMISSION)
+    if wire_text is not None and engine is not None:
+        joined = _join_adjacent_strings(wire_text)
+        messages = dict(_py_string_map(engine, "STATUS_MESSAGES"))
+        if not messages:
+            findings.append(
+                Finding(
+                    MISSING,
+                    ENGINE,
+                    1,
+                    "STATUS_MESSAGES not extractable (error-string "
+                    "taxonomy anchor)",
+                )
+            )
+        if admission is not None:
+            overload = _py_str_const(admission, "OVERLOAD_MESSAGE")
+            if overload is None:
+                findings.append(
+                    Finding(
+                        MISSING,
+                        ADMISSION,
+                        1,
+                        "OVERLOAD_MESSAGE not extractable",
+                    )
+                )
+            else:
+                messages["STATUS_OVERLOADED"] = overload
+        for const, msg in sorted(messages.items()):
+            escaped = msg.replace('"', '\\"')
+            if f"-ERR {escaped}" not in joined:
+                findings.append(
+                    Finding(
+                        DRIFT,
+                        WIRE_CPP,
+                        1,
+                        f"RESP payload for {const} "
+                        f"(\"-ERR {msg}\") missing or drifted from "
+                        "the Python error taxonomy",
+                    )
+                )
+
+    return findings

@@ -416,6 +416,12 @@ class DenyCache:
                                 and 0 <= tol < _BOUND
                                 and 0 <= cur_ns < _BOUND
                             ):
+                                # Pop-then-reinsert: a refreshed key
+                                # moves to the dict's end so FIFO
+                                # eviction tracks last-write age, not
+                                # first-insertion — hot keys must not
+                                # be the first evicted.
+                                records_pop(key, None)
                                 records[key] = (cur_ns, tol, seq)
                                 if len(records) > cap:
                                     records_pop(next(iter(records)))
@@ -525,6 +531,10 @@ class DenyCache:
         if not 0 <= tat < _BOUND:
             self._records.pop(key, None)
             return
+        # Pop-then-reinsert so FIFO eviction tracks last-write age —
+        # a refreshed hot key must not stay parked at the front of
+        # the eviction queue.
+        self._records.pop(key, None)
         self._records[key] = (tat, tol, seq)
         while len(self._records) > self.capacity:
             self._records.pop(next(iter(self._records)))

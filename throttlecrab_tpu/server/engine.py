@@ -319,26 +319,38 @@ class BatchingEngine:
         status_l = result.status.tolist()
         allowed_l = result.allowed.tolist()
         cur_l = cur.tolist() if cur is not None else None
+        if cur_l is not None or wire:
+            # Bulk path (one cache-lock acquisition per window, the
+            # native driver's twin): a row's cur_ns is None on the
+            # whole-second tiers — allowed rows still invalidate,
+            # denials can't certify there — and a non-OK row never
+            # reached the table, so it rides along as an
+            # uncertifiable denial purely to release its hold.
+            rows = []
+            for i, (r, _) in enumerate(window):
+                k = front._norm_key(r.key)
+                if k is None:
+                    continue  # begin_inflight was a no-op for it too
+                ok = status_l[i] == STATUS_OK
+                rows.append((
+                    k, r.max_burst, r.count_per_period, r.period,
+                    r.quantity, ok and bool(allowed_l[i]),
+                    cur_l[i] if (ok and cur_l is not None) else None,
+                ))
+            front.observe_window(rows, now_ns, seq)
+            return
+        # Full-nanosecond planes: per-row observe — the exact TAT is
+        # reconstructed from reset/retry, which the bulk rows don't
+        # carry.
         for i, (r, _) in enumerate(window):
             try:
                 if status_l[i] != STATUS_OK:
                     continue
-                allowed = bool(allowed_l[i])
-                kw = {}
-                if cur_l is not None:
-                    kw["cur_ns"] = cur_l[i]
-                elif wire:
-                    # Whole-second planes cannot reconstruct the exact
-                    # TAT; denials can't certify, but allowed rows must
-                    # still invalidate cached denials for the key.
-                    if not allowed:
-                        continue
-                else:
-                    kw["reset_after_ns"] = int(result.reset_after_ns[i])
-                    kw["retry_after_ns"] = int(result.retry_after_ns[i])
                 front.observe(
                     r.key, r.max_burst, r.count_per_period, r.period,
-                    r.quantity, now_ns, allowed, seq, **kw,
+                    r.quantity, now_ns, bool(allowed_l[i]), seq,
+                    reset_after_ns=int(result.reset_after_ns[i]),
+                    retry_after_ns=int(result.retry_after_ns[i]),
                 )
             finally:
                 front.end_inflight(r.key)

@@ -23,6 +23,34 @@ from typing import Dict, List, Optional, Tuple
 MAX_KEY_LENGTH = 256  # metrics.rs:21
 MAX_TRACKED_DENIED_KEYS = 10_000  # metrics.rs:119-121
 
+# The dashboard contract: every metric name this server can emit, in
+# export order.  scripts/check_invariants.py (analysis/registry.py)
+# enforces both directions — a name emitted anywhere in the package
+# must be registered here, and a registered name must still be emitted
+# somewhere — so renames and additions cannot drift past dashboards
+# silently.
+METRIC_NAMES = (
+    "throttlecrab_uptime_seconds",
+    "throttlecrab_requests_total",
+    "throttlecrab_requests_by_transport",
+    "throttlecrab_requests_allowed",
+    "throttlecrab_requests_denied",
+    "throttlecrab_requests_errors",
+    "throttlecrab_top_denied_keys",
+    "throttlecrab_tpu_device_launches",
+    "throttlecrab_tpu_batched_requests",
+    "throttlecrab_tpu_max_batch_size",
+    "throttlecrab_tpu_sweeps",
+    "throttlecrab_tpu_expired_hits",
+    "throttlecrab_tpu_slots_freed",
+    "throttlecrab_tpu_front_deny_hits",
+    "throttlecrab_tpu_front_shed",
+    "throttlecrab_tpu_front_stale_evictions",
+    "throttlecrab_tpu_front_deny_cache_size",
+    "throttlecrab_cluster_forwarded_total",
+    "throttlecrab_cluster_failed_total",
+)
+
 
 class TopDeniedKeys:
     """Bounded denied-key counter (metrics.rs:24-76).

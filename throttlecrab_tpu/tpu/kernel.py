@@ -376,7 +376,7 @@ def _request_outputs(t, inc, emission, tol, now):
     # `wrap_i64(now + tol)`), so a tolerance big enough to overflow
     # now + tol wraps negative and `remaining` collapses to 0.  XLA's
     # plain i64 add has exactly those two's-complement semantics.
-    burst_limit = now + tol
+    burst_limit = now + tol  # inv: allow(i64-raw-op)
     room = sat_sub(burst_limit, cur)
     remaining = jnp.where(
         emission > 0, jnp.maximum(div_trunc(room, emission), 0), 0
@@ -486,7 +486,7 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False,
     # too; for every non-overflowing input the plain add is identical
     # (and cheaper).  `num` above must STAY saturating — the closed
     # form's allow condition matches the oracle's saturating chain.
-    burst_limit = now + tol
+    burst_limit = now + tol  # inv: allow(i64-raw-op)
     room_main = sat_sub(burst_limit, cur_main)
     remaining_main = jnp.where(
         em > 0, jnp.maximum(div_trunc(room_main, em), 0), 0
@@ -656,7 +656,9 @@ def _finish(
     # One stacked output → one device-to-host fetch.
     if compact == "cur":
         assert cur is not None, 'compact="cur" requires with_degen=False'
-        out = cur * 2 + allowed.astype(jnp.int64)
+        # fits_cur_wire certifies |cur| < 2**62, so the shift-and-tag
+        # word cannot overflow.
+        out = cur * 2 + allowed.astype(jnp.int64)  # inv: allow(i64-raw-op)
     elif compact == "w32":
         # 4 B/request: the four exact wire values packed into one i32 —
         # allowed(1) | remaining(10) | reset_s(11) | retry_s(22..31).

@@ -301,7 +301,10 @@ def derive_params(max_burst, count_per_period, period):
     emission = np.where(emission < 0, 0, emission)
 
     b32 = (max_burst - 1).astype(np.uint64) & np.uint64(0xFFFFFFFF)
-    tolerance = (emission.astype(np.uint64) * b32).astype(np.int64)
+    # Deliberately WRAPPING u64 product (rate_limiter.rs:122 semantics).
+    tolerance = (
+        emission.astype(np.uint64) * b32  # inv: allow(i64-raw-op)
+    ).astype(np.int64)
     return emission, tolerance, invalid
 
 

@@ -282,7 +282,9 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
         if bool(sat.any()):
             note(None)
         else:
-            note(int((exp_arr - tat_arr).max(initial=0)))
+            # Wrap-free: the f64 probe above saturated every lane whose
+            # difference could approach 2**61.
+            note(int((exp_arr - tat_arr).max(initial=0)))  # inv: allow(i64-raw-op)
     # The restored TATs also embed the WRITER's clock: tat <= writer_now
     # + tol, and a reader whose clock lags the writer would pass the w32
     # certificate while reset/retry overflow their fields.  Seeding
