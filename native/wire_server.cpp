@@ -349,6 +349,9 @@ struct WireServer {
     // /metrics snapshot pushed by the driver (HTTP protocol only).
     std::mutex m_mu;
     std::string metrics_text;
+    // /health body pushed by the driver (failure-domain state machine:
+    // "OK" | "retrying" | "degraded" | "recovering").
+    std::string health_text = "OK";
 
     // stats
     std::atomic<uint64_t> n_conns{0}, n_requests{0}, n_inline{0};
@@ -739,7 +742,12 @@ struct WireServer {
         c.rbuf.erase(0, total);
 
         if (method == "GET" && path == "/health") {
-            send_http(c, 200, "text/plain", "OK", keep_alive);
+            std::string text;
+            {
+                std::lock_guard<std::mutex> lk(m_mu);
+                text = health_text;
+            }
+            send_http(c, 200, "text/plain", text, keep_alive);
             return 1;
         }
         if (method == "GET" && path == "/metrics") {
@@ -958,6 +966,15 @@ void ws_set_metrics(void* h, const char* text, int64_t len) {
     auto* s = static_cast<WireServer*>(h);
     std::lock_guard<std::mutex> lk(s->m_mu);
     s->metrics_text.assign(text, len);
+}
+
+// Push the serving state for GET /health (HTTP protocol): "OK" while
+// healthy, else the supervisor's state name (always HTTP 200 — a
+// degraded node is still serving).
+void ws_set_health(void* h, const char* text, int64_t len) {
+    auto* s = static_cast<WireServer*>(h);
+    std::lock_guard<std::mutex> lk(s->m_mu);
+    s->health_text.assign(text, len);
 }
 
 uint16_t ws_port(void* h) { return static_cast<WireServer*>(h)->port; }

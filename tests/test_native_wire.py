@@ -359,3 +359,73 @@ def test_native_concurrent_clients_share_limits():
 
     counts = asyncio.run(main())
     assert sum(counts) == 20  # burst 20 across 40 attempts on 4 conns
+
+
+def test_stop_wakes_parked_driver_promptly():
+    """Drain-correct shutdown: with a huge linger the driver parks deep
+    inside ws_next_batch — stop() must wake it via the C++ poison pill
+    (running flag + condvar notify) and join within a bounded time, not
+    sleep out the linger or silently leak the thread."""
+    import time
+
+    async def main():
+        transport, _ = make_transport(max_linger_us=30_000_000)  # 30 s
+        await transport.start()
+        await asyncio.sleep(0.3)  # let the driver park in ws_next_batch
+        t0 = time.monotonic()
+        await transport.stop()
+        elapsed = time.monotonic() - t0
+        return elapsed, transport._driver
+
+    elapsed, driver = asyncio.run(main())
+    assert elapsed < 5.0, f"stop took {elapsed:.1f}s (linger not interrupted)"
+    assert not driver.is_alive()
+
+
+def test_native_http_health_reflects_supervisor_state():
+    """The native HTTP wire layer serves /health from the pushed
+    failure-domain state, not a hardcoded OK."""
+    from throttlecrab_tpu.server.native_http import NativeHttpTransport
+    from throttlecrab_tpu.server.supervisor import SupervisedLimiter
+
+    async def http_get(port, path):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        head = await asyncio.wait_for(
+            reader.readuntil(b"\r\n\r\n"), timeout=5.0
+        )
+        length = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                length = int(line.split(b":", 1)[1])
+        body = await reader.readexactly(length)
+        writer.close()
+        return body
+
+    async def main():
+        metrics = Metrics()
+        limiter = SupervisedLimiter(TpuRateLimiter(capacity=256))
+        transport = NativeHttpTransport(
+            "127.0.0.1", 0, limiter, metrics,
+            batch_size=16, max_linger_us=500, now_fn=lambda: T0,
+        )
+        await transport.start()
+        try:
+            await asyncio.sleep(0.2)  # first _push_metrics ran
+            ok_body = await http_get(transport.bound_port, "/health")
+            # Force the state machine into degraded and push again.
+            limiter._set_state("degraded")
+            transport._push_metrics()
+            degraded_body = await http_get(
+                transport.bound_port, "/health"
+            )
+            return ok_body, degraded_body
+        finally:
+            await transport.stop()
+
+    ok_body, degraded_body = asyncio.run(main())
+    assert ok_body == b"OK"
+    assert degraded_body == b"degraded"

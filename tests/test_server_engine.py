@@ -185,6 +185,79 @@ def test_cleanup_policy_sweeps_between_batches():
     assert len(limiter) <= 2  # "x" (and possibly "y") swept
 
 
+def test_shutdown_resolves_inflight_futures_when_final_flush_raises():
+    """Drain-correct shutdown: even when the final flush's launch
+    raises, every in-flight future must resolve (ThrottleError), never
+    hang — a stuck shutdown is the wedge this repo's round-5 verdict
+    documents."""
+
+    async def main():
+        engine, _ = make_engine(batch_size=4096, max_linger_us=10_000_000)
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected final-flush launch failure")
+
+        engine.limiter.dispatch_many = boom
+        engine.limiter.rate_limit_many = boom
+        engine.limiter.rate_limit_batch = boom
+        pending = [
+            asyncio.ensure_future(engine.throttle(req(key=f"s{i}")))
+            for i in range(5)
+        ]
+        await asyncio.sleep(0)  # requests land in the pending deque
+        await asyncio.wait_for(engine.shutdown(), timeout=2.0)
+        # Resolve (with the error), not hang: wait_for pins the "never
+        # hang" half of the contract.
+        return await asyncio.wait_for(
+            asyncio.gather(*pending, return_exceptions=True), timeout=2.0
+        )
+
+    results = run(main())
+    assert len(results) == 5
+    assert all(isinstance(r, ThrottleError) for r in results)
+
+
+def test_post_shutdown_requests_have_defined_status_per_transport():
+    """After shutdown every transport maps the refusal to its
+    protocol's error shape: engine ThrottleError("engine is shut
+    down") → HTTP 500 {"error": ...} / RESP -ERR; /health says
+    "shutdown"."""
+    import json
+
+    from throttlecrab_tpu.server.http import HttpTransport
+    from throttlecrab_tpu.server.redis import RedisTransport
+    from throttlecrab_tpu.server.resp import BulkString, Error
+
+    async def main():
+        engine, _ = make_engine(batch_size=8, max_linger_us=500)
+        metrics = Metrics()
+        await engine.shutdown()
+        with pytest.raises(ThrottleError, match="shut down"):
+            await engine.throttle(req(key="late"))
+
+        http = HttpTransport("127.0.0.1", 0, engine, metrics)
+        body = json.dumps(
+            {"key": "late", "max_burst": 1, "count_per_period": 1,
+             "period": 1}
+        ).encode()
+        status, payload, _ctype = await http._handle_throttle(body)
+        health = await http._route("GET", "/health", b"")
+
+        redis = RedisTransport("127.0.0.1", 0, engine, metrics)
+        resp = await redis._handle_throttle(
+            (BulkString("THROTTLE"), BulkString("late"), BulkString("1"),
+             BulkString("1"), BulkString("1"))
+        )
+        return status, payload, health, resp
+
+    status, payload, health, resp = run(main())
+    assert status == 500
+    assert "shut down" in json.loads(payload)["error"]
+    assert health == (200, b"shutdown", "text/plain")
+    assert isinstance(resp, Error)
+    assert resp.value.startswith("ERR") and "shut down" in resp.value
+
+
 def test_shutdown_flushes_then_refuses():
     async def main():
         engine, _ = make_engine(batch_size=4096, max_linger_us=10_000_000)

@@ -384,3 +384,99 @@ def test_cluster_snapshot_delegates_to_local(tmp_path):
     )
     assert load_snapshot(cl2, path, now_ns=T0 + NS) == 51
     _check_continuity(cl2)
+
+
+# ------------------------------------------------------------------ #
+# Corruption hardening (failure-domain PR): a bad snapshot must raise
+# one typed SnapshotError, and the boot path must apply the
+# THROTTLECRAB_SNAPSHOT_STRICT policy instead of crashing.
+
+
+def _write_real_snapshot(tmp_path, now_ns=T0):
+    path = tmp_path / "snap.npz"
+    lim = TpuRateLimiter(capacity=256)
+    lim.rate_limit_batch(
+        [f"k{i}" for i in range(40)], 5, 10, 3600, 1, now_ns
+    )
+    save_snapshot(lim, path)
+    return path
+
+
+@pytest.mark.parametrize("keep_frac", [0.1, 0.5, 0.9])
+def test_truncated_snapshot_raises_snapshot_error(tmp_path, keep_frac):
+    """Truncate a real snapshot mid-file at several points: every cut
+    must surface as SnapshotError, never a raw zipfile/zlib crash."""
+    from throttlecrab_tpu.tpu.snapshot import SnapshotError
+
+    path = _write_real_snapshot(tmp_path)
+    blob = path.read_bytes()
+    path.write_bytes(blob[: max(int(len(blob) * keep_frac), 1)])
+    lim = TpuRateLimiter(capacity=256)
+    with pytest.raises(SnapshotError):
+        load_snapshot(lim, path, now_ns=T0 + NS)
+
+
+def test_garbage_and_mismatched_snapshots_raise_snapshot_error(tmp_path):
+    from throttlecrab_tpu.tpu.snapshot import SnapshotError
+
+    garbage = tmp_path / "garbage.npz"
+    garbage.write_bytes(b"\x00not a zip at all")
+    lim = TpuRateLimiter(capacity=256)
+    with pytest.raises(SnapshotError):
+        load_snapshot(lim, garbage, now_ns=T0)
+
+    # Internally inconsistent column lengths.
+    bad = tmp_path / "bad.npz"
+    np.savez_compressed(
+        bad,
+        version=np.int64(2),
+        capacity=np.int64(256),
+        slots=np.zeros(2, np.int64),
+        shard=np.zeros(2, np.int32),
+        n_shards=np.int64(1),
+        tat=np.zeros(2, np.int64),
+        expiry=np.zeros(1, np.int64),  # mismatched
+        key_offsets=np.zeros(3, np.int64),
+        key_blob=np.zeros(0, np.uint8),
+        key_is_bytes=np.zeros(2, np.uint8),
+        key_codec=np.zeros(2, np.uint8),
+        source_bytes_keys=np.uint8(0),
+        meta=np.frombuffer(b'{"n_keys": 2}', np.uint8),
+    )
+    with pytest.raises(SnapshotError):
+        load_snapshot(TpuRateLimiter(capacity=256), bad, now_ns=T0)
+
+
+def test_boot_restore_strict_refuses_nonstrict_starts_empty(tmp_path):
+    """server/__main__.py restore-on-boot: strict (default) refuses to
+    start on a corrupt snapshot with a clear error; non-strict
+    (THROTTLECRAB_SNAPSHOT_STRICT=0) logs and starts empty."""
+    from throttlecrab_tpu.server.__main__ import (
+        SnapshotRefused,
+        restore_snapshot_on_boot,
+    )
+    from throttlecrab_tpu.server.config import Config
+
+    path = _write_real_snapshot(tmp_path)
+    blob = path.read_bytes()
+    path.write_bytes(blob[: len(blob) // 2])
+
+    strict = Config(http=True, snapshot_path=str(path))
+    with pytest.raises(SnapshotRefused, match="SNAPSHOT_STRICT"):
+        restore_snapshot_on_boot(TpuRateLimiter(capacity=256), strict)
+
+    lax = Config(http=True, snapshot_path=str(path), snapshot_strict=False)
+    lim = TpuRateLimiter(capacity=256)
+    assert restore_snapshot_on_boot(lim, lax) == 0
+    assert len(lim) == 0  # empty table, but the server boots
+
+    # And a healthy snapshot restores normally through the same path
+    # (stamped with the real clock: restore-on-boot's TTL gate uses
+    # wall time).
+    import time
+
+    good = _write_real_snapshot(tmp_path, now_ns=time.time_ns())
+    lim2 = TpuRateLimiter(capacity=256)
+    assert restore_snapshot_on_boot(lim2, Config(
+        http=True, snapshot_path=str(good)
+    )) == 40

@@ -1,0 +1,21 @@
+"""Fault injection (chaos) subsystem.
+
+Deterministic, virtual-time-friendly fault injection threaded through
+the five real failure surfaces (device launch, deferred fetch, cluster
+peer socket, keymap capacity exhaustion, snapshot I/O).  Armed via the
+``THROTTLECRAB_FAULTS`` knob or :func:`arm`; see injector.py for the
+spec grammar and the exception taxonomy each site reproduces.
+"""
+
+from .injector import (  # noqa: F401  (re-exported API)
+    MODES,
+    SITES,
+    FaultInjector,
+    FaultSpec,
+    InjectedDeviceError,
+    active_injector,
+    arm,
+    disarm,
+    maybe_fail,
+    parse_spec,
+)

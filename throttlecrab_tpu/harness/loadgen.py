@@ -33,6 +33,41 @@ class PerfResult:
     denied: int
     errors: int
     latencies_s: List[float] = field(default_factory=list, repr=False)
+    # Chaos-run resilience tracking (--chaos): how the client
+    # experienced injected server-side faults.
+    max_consecutive_errors: int = 0
+    _consecutive_errors: int = field(default=0, repr=False)
+    first_error_s: float = -1.0
+    last_recovery_s: float = -1.0
+
+    def track_outcome(self, is_error: bool, t_s: float) -> None:
+        """Feed per-request outcomes (in completion order) for the
+        chaos stats: longest error run and the last error→success
+        recovery timestamp."""
+        if is_error:
+            self._consecutive_errors += 1
+            self.max_consecutive_errors = max(
+                self.max_consecutive_errors, self._consecutive_errors
+            )
+            if self.first_error_s < 0:
+                self.first_error_s = t_s
+        else:
+            if self._consecutive_errors:
+                self.last_recovery_s = t_s
+            self._consecutive_errors = 0
+
+    def chaos_summary(self) -> dict:
+        return {
+            "error_rate": round(
+                self.errors / self.total_requests, 6
+            ) if self.total_requests else 0.0,
+            "max_consecutive_errors": self.max_consecutive_errors,
+            "first_error_s": round(self.first_error_s, 3),
+            "last_recovery_s": round(self.last_recovery_s, 3),
+            "recovered": (
+                self.errors == 0 or self.last_recovery_s >= 0
+            ),
+        }
 
     @property
     def rps(self) -> float:
@@ -278,6 +313,7 @@ async def run_perf_test(
     workload: str = "steady",
     target_rps: float = 0.0,
     pipeline: int = 1,
+    chaos: bool = False,
 ) -> PerfResult:
     """Barrier-synchronized workers, pre-generated keys
     (perf_test_multi_transport.rs:48-127).
@@ -304,6 +340,17 @@ async def run_perf_test(
             result.allowed += 1
         else:
             result.denied += 1
+        if chaos:
+            result.track_outcome(
+                allowed is None, time.perf_counter() - t_start
+            )
+
+    def tally_errors(n: int) -> None:
+        result.errors += n
+        if chaos:
+            t = time.perf_counter() - t_start
+            for _ in range(n):
+                result.track_outcome(True, t)
 
     async def worker(w: int) -> None:
         client = clients[w]
@@ -319,12 +366,12 @@ async def run_perf_test(
                         window, burst, count, period
                     )
                 except Exception:
-                    result.errors += len(window)
+                    tally_errors(len(window))
                     try:
                         await client.close()
                         await client.connect()
                     except Exception:
-                        result.errors += len(keys) - start - len(window)
+                        tally_errors(len(keys) - start - len(window))
                         return
                     continue
                 result.latencies_s.append(time.perf_counter() - t0)
@@ -338,7 +385,7 @@ async def run_perf_test(
             try:
                 allowed = await client.throttle(key, burst, count, period)
             except Exception:
-                result.errors += 1
+                tally_errors(1)
                 # The stream may hold a half-read response; a reconnect is
                 # the only way to resynchronize the framing.  Abort the
                 # worker if the server is truly gone.
@@ -346,7 +393,7 @@ async def run_perf_test(
                     await client.close()
                     await client.connect()
                 except Exception:
-                    result.errors += len(keys) - done - 1
+                    tally_errors(len(keys) - done - 1)
                     return
                 continue
             result.latencies_s.append(time.perf_counter() - t0)
@@ -375,7 +422,13 @@ def main(argv=None) -> int:
                    help="requests per worker")
     p.add_argument("--key-pattern", default="random",
                    choices=["sequential", "random", "zipfian",
-                            "user-resource", "hotkey-abuse"])
+                            "user-resource", "hotkey-abuse", "chaos"])
+    p.add_argument("--chaos", action="store_true",
+                   help="chaos run against a THROTTLECRAB_FAULTS-armed "
+                        "server: drives the 'chaos' key pattern (hot "
+                        "abuse + cold + keymap-churn bands) and reports "
+                        "resilience stats (error rate, longest error "
+                        "run, recovery) alongside the latency summary")
     p.add_argument("--key-space", type=int, default=10_000)
     p.add_argument("--workload", default="steady",
                    choices=["steady", "burst", "ramp", "wave"])
@@ -404,11 +457,14 @@ def main(argv=None) -> int:
     ports = {"http": args.port, "grpc": args.grpc_port,
              "redis": args.redis_port}
     for transport in transports:
+        key_pattern = args.key_pattern
+        if args.chaos and key_pattern == "random":
+            key_pattern = "chaos"  # the chaos default; explicit wins
         kwargs = dict(
             burst=args.burst, count=args.count, period=args.period,
-            key_pattern=args.key_pattern, key_space=args.key_space,
+            key_pattern=key_pattern, key_space=args.key_space,
             workload=args.workload, target_rps=args.target_rps,
-            pipeline=args.pipeline,
+            pipeline=args.pipeline, chaos=args.chaos,
         )
         if args.procs > 1:
             result = run_multiproc(
@@ -427,6 +483,8 @@ def main(argv=None) -> int:
             summary["pipeline"] = args.pipeline
         if args.procs > 1:
             summary["procs"] = args.procs
+        if args.chaos:
+            summary["chaos"] = result.chaos_summary()
         print(json.dumps(summary))
     return 0
 
@@ -438,6 +496,8 @@ def _proc_entry(transport, host, port, workers, requests, kwargs):
     return (
         result.total_requests, result.elapsed_s, result.allowed,
         result.denied, result.errors, result.latencies_s,
+        result.max_consecutive_errors, result.first_error_s,
+        result.last_recovery_s,
     )
 
 
@@ -465,13 +525,22 @@ def run_multiproc(
             ],
         )
     merged = PerfResult(transport, 0, 0.0, 0, 0, 0)
-    for total, elapsed, allowed, denied, errors, lats in parts:
+    for (total, elapsed, allowed, denied, errors, lats,
+         max_consec, first_err, last_rec) in parts:
         merged.total_requests += total
         merged.elapsed_s = max(merged.elapsed_s, elapsed)
         merged.allowed += allowed
         merged.denied += denied
         merged.errors += errors
         merged.latencies_s.extend(lats)
+        merged.max_consecutive_errors = max(
+            merged.max_consecutive_errors, max_consec
+        )
+        if first_err >= 0 and (
+            merged.first_error_s < 0 or first_err < merged.first_error_s
+        ):
+            merged.first_error_s = first_err
+        merged.last_recovery_s = max(merged.last_recovery_s, last_rec)
     return merged
 
 

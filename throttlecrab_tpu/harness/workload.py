@@ -84,6 +84,22 @@ def make_keys(
         cold = rng.integers(n_hot, max(key_space, n_hot + 1), n_requests)
         is_hot = rng.random(n_requests) < 0.9
         ids = np.where(is_hot, hot, cold)
+    elif pattern == "chaos":
+        # The chaos-run companion (harness --chaos) for a server armed
+        # with THROTTLECRAB_FAULTS: half hot-key abuse (exercises the
+        # deny cache across degrade/re-promote invalidations), 40%
+        # random cold keys (exercises the supervised launch path), and
+        # a 10% ever-fresh churn band (monotone new keys, pressuring
+        # keymap growth — the capacity-exhaustion fault surface).
+        n_hot = max(key_space // 1000, 1)
+        hot = rng.integers(0, n_hot, n_requests)
+        cold = rng.integers(n_hot, max(key_space, n_hot + 1), n_requests)
+        # Per-worker/run disjoint band (seed-offset): every worker of
+        # every run must bring genuinely fresh keys, or the growth
+        # pressure this band exists for fades after the first run.
+        churn = key_space + (seed + 1) * n_requests + np.arange(n_requests)
+        u = rng.random(n_requests)
+        ids = np.where(u < 0.5, hot, np.where(u < 0.9, cold, churn))
     else:
         raise ValueError(f"unknown key pattern: {pattern!r}")
     return [f"key:{i}" for i in ids]

@@ -50,6 +50,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..faults import maybe_fail
 from ..tpu.limiter import (
     BatchResult,
     _ReadyLaunch,
@@ -293,9 +294,11 @@ class PeerConnection:
                 self._sock = None
 
     def send_frame(self, frame: bytes) -> None:
+        maybe_fail("peer")
         self._connect().sendall(frame)
 
     def recv_frame(self) -> Tuple[int, bytes]:
+        maybe_fail("peer")
         s = self._connect()
         head = self._recv_exact(s, _HDR.size)
         body_len, op = _HDR.unpack(head)

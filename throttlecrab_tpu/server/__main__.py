@@ -16,7 +16,12 @@ import sys
 from .config import Config, ConfigError
 from .engine import BatchingEngine
 from .metrics import Metrics
-from .store import create_cleanup_policy, create_front_tier, create_limiter
+from .store import (
+    create_cleanup_policy,
+    create_front_tier,
+    create_limiter,
+    create_supervised_limiter,
+)
 
 log = logging.getLogger("throttlecrab")
 
@@ -100,12 +105,86 @@ def build_transports(config: Config, engine, metrics):
     return transports
 
 
+class SnapshotRefused(RuntimeError):
+    """Boot refused: the snapshot is corrupt and strict mode is on."""
+
+
+def restore_snapshot_on_boot(limiter, config: Config) -> int:
+    """Restore-on-boot with the THROTTLECRAB_SNAPSHOT_STRICT policy.
+
+    A corrupt/truncated snapshot must never crash the server with a
+    raw traceback: strict mode (the default) refuses to start with a
+    clear SnapshotRefused, non-strict logs the corruption and starts
+    with an empty table.  Returns the number of keys restored (0 when
+    no snapshot exists or the non-strict path started cold)."""
+    import os as _os
+    import time as _time
+
+    from ..tpu.snapshot import SnapshotError, _normalize, load_snapshot
+
+    if not config.snapshot_path:
+        return 0
+    if not _os.path.exists(_normalize(config.snapshot_path)):
+        return 0
+    try:
+        restored = load_snapshot(
+            limiter, config.snapshot_path, _time.time_ns()
+        )
+        log.info(
+            "restored %d keys from snapshot %s",
+            restored, config.snapshot_path,
+        )
+        return restored
+    except SnapshotError as e:
+        if config.snapshot_strict:
+            raise SnapshotRefused(
+                f"refusing to start: {e} (set "
+                "THROTTLECRAB_SNAPSHOT_STRICT=0 to log and start with "
+                "an empty table instead)"
+            ) from e
+        log.error(
+            "snapshot %s is corrupt; starting with an empty table "
+            "(THROTTLECRAB_SNAPSHOT_STRICT=0): %s",
+            config.snapshot_path, e,
+        )
+    except Exception:
+        # Non-corruption failure (e.g. capacity): soft state — a bad
+        # snapshot degrades to a cold start, never to a refused boot
+        # or wrong decisions.
+        log.exception(
+            "snapshot restore failed; starting cold (%s)",
+            config.snapshot_path,
+        )
+    # A partial restore may have populated the keymap (no rollback in
+    # bulk insert) — sweep everything so "cold" is real, not a table
+    # full of dead entries rejecting new keys.
+    try:
+        limiter.sweep(1 << 62)
+    except Exception:
+        log.exception("post-restore-failure sweep failed")
+    return 0
+
+
 async def run_server(config: Config) -> None:
     metrics = (
         Metrics.builder().max_denied_keys(config.max_denied_keys).build()
     )
     log.info("starting rate limiter with %s store", config.store)
-    limiter = create_limiter(config)
+    if config.faults:
+        # Chaos arming: deterministic injected faults at the five real
+        # failure surfaces (throttlecrab_tpu/faults/).
+        from ..faults import FaultInjector, arm, parse_spec
+
+        arm(FaultInjector(parse_spec(config.faults),
+                          seed=config.faults_seed))
+        log.warning("fault injection armed: %s", config.faults)
+    device_limiter = create_limiter(config)
+    # Failure-domain supervision (L3.75): every transport drives the
+    # same supervised limiter, so retry/degrade/re-promote decisions
+    # are made once, under the shared limiter lock.
+    limiter = create_supervised_limiter(config, device_limiter, metrics)
+    supervisor = limiter
+    metrics.set_engine_state_provider(lambda: supervisor.state)
     cluster_nodes = config.cluster_node_list()
     if cluster_nodes:
         # Multi-node deployment: every key has one owner node (salted
@@ -126,42 +205,15 @@ async def run_server(config: Config) -> None:
             connect_timeout_s=config.cluster_connect_timeout_ms / 1000.0,
         )
         metrics.set_cluster_stats_provider(limiter.peer_stats)
-    if config.snapshot_path:
-        import os as _os
-        import time as _time
-
-        from ..tpu.snapshot import _normalize
-
-        if _os.path.exists(_normalize(config.snapshot_path)):
-            from ..tpu.snapshot import load_snapshot
-
-            try:
-                restored = load_snapshot(
-                    limiter, config.snapshot_path, _time.time_ns()
-                )
-                log.info(
-                    "restored %d keys from snapshot %s",
-                    restored, config.snapshot_path,
-                )
-            except Exception:
-                # Soft state: a bad snapshot degrades to a cold start,
-                # never to a refused boot or wrong decisions.  A partial
-                # restore may have populated the keymap (no rollback in
-                # bulk insert) — sweep everything so "cold" is real, not
-                # a table full of dead entries rejecting new keys.
-                log.exception(
-                    "snapshot restore failed; starting cold (%s)",
-                    config.snapshot_path,
-                )
-                try:
-                    limiter.sweep(1 << 62)
-                except Exception:
-                    log.exception("post-restore-failure sweep failed")
+    restore_snapshot_on_boot(limiter, config)
     # Front tier (L3.5): exact deny cache + admission control, shared
     # by the asyncio engine and the native transports.  Built after the
     # snapshot restore on purpose — the cache must start empty against
     # restored foreign state.
     front = create_front_tier(config, metrics, limiter)
+    # Re-promotion rewrites bucket state out from under cached denials:
+    # the supervisor needs the front's on_restore hook.
+    supervisor.front = front
     engine = BatchingEngine(
         limiter,
         batch_size=config.batch_size,
@@ -279,6 +331,9 @@ def main(argv=None) -> int:
         asyncio.run(run_server(config))
     except KeyboardInterrupt:
         pass
+    except SnapshotRefused as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     except TransportFailure:
         return 1
     return 0

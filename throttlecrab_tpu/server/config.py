@@ -89,6 +89,33 @@ _SPEC = [
     ("snapshot_path", "THROTTLECRAB_SNAPSHOT_PATH", "", str,
      "Snapshot file (.npz): restored at startup when present, written on "
      "graceful shutdown (empty: disabled; state is soft either way)"),
+    ("snapshot_strict", "THROTTLECRAB_SNAPSHOT_STRICT", True, bool,
+     "Refuse to start when the boot snapshot is corrupt/truncated "
+     "(env 0 disables: log the corruption and start with an empty "
+     "table instead)"),
+    # --- failure-domain supervision (server/supervisor.py, faults/) ----
+    ("supervisor_retries", "THROTTLECRAB_SUPERVISOR_RETRIES", 3, int,
+     "Max retries of a transient (UNAVAILABLE-shaped) device "
+     "launch/fetch fault before the device is declared down"),
+    ("supervisor_backoff_us", "THROTTLECRAB_SUPERVISOR_BACKOFF_US",
+     2000, int,
+     "Initial retry backoff in microseconds (doubles per retry)"),
+    ("supervisor_backoff_max_us",
+     "THROTTLECRAB_SUPERVISOR_BACKOFF_MAX_US", 50_000, int,
+     "Retry backoff ceiling in microseconds"),
+    ("supervisor_probe_interval_ms",
+     "THROTTLECRAB_SUPERVISOR_PROBE_INTERVAL_MS", 1000, int,
+     "Degraded mode: milliseconds between device recovery probes"),
+    ("supervisor_mode", "THROTTLECRAB_SUPERVISOR_MODE", "degrade", str,
+     "On persistent device failure: degrade (keep serving from the "
+     "host scalar oracle, re-promote on recovery) or fail (error the "
+     "affected batches)"),
+    ("faults", "THROTTLECRAB_FAULTS", "", str,
+     "Fault injection spec site:mode[:arg],... — sites launch, fetch, "
+     "peer, keymap, snapshot; modes transient:p, persistent, count:n, "
+     "hang:seconds (empty: off; see throttlecrab_tpu/faults/)"),
+    ("faults_seed", "THROTTLECRAB_FAULTS_SEED", 0, int,
+     "Seed for the deterministic fault-injection probability stream"),
     ("cluster_nodes", "THROTTLECRAB_CLUSTER_NODES", "", str,
      "Comma-separated host:port cluster RPC addresses of every node "
      "(same list on every node; empty: single-node)"),
@@ -144,6 +171,14 @@ class Config:
     front_max_wait_us: int = 0
     front_peek_frac: float = 0.9
     snapshot_path: str = ""
+    snapshot_strict: bool = True
+    supervisor_retries: int = 3
+    supervisor_backoff_us: int = 2000
+    supervisor_backoff_max_us: int = 50_000
+    supervisor_probe_interval_ms: int = 1000
+    supervisor_mode: str = "degrade"
+    faults: str = ""
+    faults_seed: int = 0
     cluster_nodes: str = ""
     cluster_index: int = 0
     cluster_bind_host: str = "0.0.0.0"
@@ -205,6 +240,24 @@ class Config:
             raise ConfigError("front admission bounds must be >= 0")
         if not 0.0 < self.front_peek_frac <= 1.0:
             raise ConfigError("front_peek_frac must be in (0, 1]")
+        if self.supervisor_mode not in ("degrade", "fail"):
+            raise ConfigError(
+                f"Invalid supervisor mode: {self.supervisor_mode!r} "
+                "(expected degrade or fail)"
+            )
+        if self.supervisor_retries < 0:
+            raise ConfigError("supervisor_retries must be >= 0")
+        if self.supervisor_backoff_us < 0 or self.supervisor_backoff_max_us < 0:
+            raise ConfigError("supervisor backoffs must be >= 0")
+        if self.supervisor_probe_interval_ms <= 0:
+            raise ConfigError("supervisor_probe_interval_ms must be > 0")
+        if self.faults:
+            from ..faults import parse_spec
+
+            try:
+                parse_spec(self.faults)
+            except ValueError as e:
+                raise ConfigError(f"invalid --faults spec: {e}") from e
         nodes = self.cluster_node_list()
         if nodes:
             if not 0 <= self.cluster_index < len(nodes):

@@ -18,6 +18,16 @@ reference's transport-task/actor-task split.
 Cleanup runs between batches: the engine consults a `CleanupPolicy`
 (tpu/cleanup.py — periodic / probabilistic / adaptive, the reference's three
 store flavors) and triggers the expiry-compaction sweep on the device.
+
+Failure domains: launch supervision lives in the limiter wrapper
+(server/supervisor.py) shared with the native drivers — a launch
+exception reaching this engine's except-branches means the supervisor
+already retried transient faults and either degraded to the host oracle
+(in which case the "launch" succeeds against it and no exception
+arrives) or classified the failure as deterministic/unrecoverable, so
+failing the window's futures is the correct terminal answer.  The
+engine surfaces the supervisor's state machine through `health_state()`
+(GET /health).
 """
 
 from __future__ import annotations
@@ -597,6 +607,16 @@ class BatchingEngine:
                     self.metrics.record_expired_hits(drained)
                 if freed is not None:
                     self.metrics.record_sweep(freed)
+
+    def health_state(self) -> str:
+        """The failure-domain state for GET /health: "ok" | "retrying"
+        | "degraded" | "recovering" ("ok" for unsupervised limiters,
+        and "shutdown" once the engine refuses new requests)."""
+        if self._closed:
+            return "shutdown"
+        from .supervisor import supervisor_state
+
+        return supervisor_state(self.limiter)
 
     async def shutdown(self) -> None:
         """Flush outstanding requests and refuse new ones."""

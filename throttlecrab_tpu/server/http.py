@@ -138,7 +138,14 @@ class HttpTransport(ConnTrackingMixin):
         if method == "POST" and path == "/throttle":
             return await self._handle_throttle(body)
         if method == "GET" and path == "/health":
-            return 200, b"OK", "text/plain"
+            # "OK" in the ok state (reference-compatible, http.rs:141);
+            # otherwise the failure-domain state machine's state name
+            # (server/supervisor.py).  Always 200: a degraded node is
+            # still serving — a load balancer must not drain exactly
+            # the traffic degraded mode exists to keep answering.
+            state = self.engine.health_state()
+            body = b"OK" if state == "ok" else state.encode()
+            return 200, body, "text/plain"
         if method == "GET" and path == "/metrics":
             return (
                 200,

@@ -47,6 +47,10 @@ METRIC_NAMES = (
     "throttlecrab_tpu_front_shed",
     "throttlecrab_tpu_front_stale_evictions",
     "throttlecrab_tpu_front_deny_cache_size",
+    "throttlecrab_tpu_engine_state",
+    "throttlecrab_tpu_supervisor_retries",
+    "throttlecrab_tpu_supervisor_degrades",
+    "throttlecrab_tpu_supervisor_repromotes",
     "throttlecrab_cluster_forwarded_total",
     "throttlecrab_cluster_failed_total",
 )
@@ -120,6 +124,11 @@ class Metrics:
         self.front_shed_consume = 0
         self.front_stale_evictions = 0
         self._front_stats = None
+        # Failure-domain supervision (server/supervisor.py).
+        self.supervisor_retries = 0
+        self.supervisor_degrades = 0
+        self.supervisor_repromotes = 0
+        self._engine_state = None
 
     @classmethod
     def builder(cls) -> "MetricsBuilder":
@@ -217,6 +226,29 @@ class Metrics:
         their bucket's TTL) lapsed."""
         with self._lock:
             self.front_stale_evictions += n
+
+    # ---- failure-domain supervision ---------------------------------- #
+
+    def record_supervisor_retry(self, n: int = 1) -> None:
+        """A transient device fault absorbed by a launch/fetch retry."""
+        with self._lock:
+            self.supervisor_retries += n
+
+    def record_supervisor_degrade(self) -> None:
+        """Persistent device failure: serving fell back to the host
+        scalar oracle."""
+        with self._lock:
+            self.supervisor_degrades += 1
+
+    def record_supervisor_repromote(self) -> None:
+        """Device recovery: host-mutated state re-promoted on-device."""
+        with self._lock:
+            self.supervisor_repromotes += 1
+
+    def set_engine_state_provider(self, provider) -> None:
+        """`provider()` -> "ok"|"retrying"|"degraded"|"recovering";
+        exported as the throttlecrab_tpu_engine_state gauge."""
+        self._engine_state = provider
 
     def set_front_stats_provider(self, provider) -> None:
         """`provider()` -> {"deny_cache_size": n}; exported as gauges
@@ -366,6 +398,34 @@ class Metrics:
             "Live deny-cache entries",
             "gauge",
             front_stats.get("deny_cache_size", 0),
+        )
+        # Failure-domain supervision (server/supervisor.py).
+        from .supervisor import STATE_GAUGE
+
+        state = self._engine_state() if self._engine_state else "ok"
+        metric(
+            "throttlecrab_tpu_engine_state",
+            "Serving state: 0=ok 1=retrying 2=degraded 3=recovering",
+            "gauge",
+            STATE_GAUGE.get(state, 0),
+        )
+        metric(
+            "throttlecrab_tpu_supervisor_retries",
+            "Transient device faults absorbed by launch/fetch retries",
+            "counter",
+            self.supervisor_retries,
+        )
+        metric(
+            "throttlecrab_tpu_supervisor_degrades",
+            "Transitions into host-oracle degraded mode",
+            "counter",
+            self.supervisor_degrades,
+        )
+        metric(
+            "throttlecrab_tpu_supervisor_repromotes",
+            "Recoveries that re-promoted host state onto the device",
+            "counter",
+            self.supervisor_repromotes,
         )
         provider = getattr(self, "_cluster_stats", None)
         if provider is not None:

@@ -1,11 +1,12 @@
 """Native HTTP/JSON transport: the C++ epoll wire layer speaking HTTP.
 
 Identical driver architecture to the native RESP backend
-(native_redis.py); the C++ side parses `POST /throttle` JSON bodies,
-answers `GET /health` inline and serves `GET /metrics` from a snapshot the
-driver refreshes every second.  Wire schema matches the reference's axum
-routes (`http.rs:61-163`): quantity defaults to 1, server-side timestamps,
-engine errors as 500 `{"error": ...}`.
+(native_redis.py); the C++ side parses `POST /throttle` JSON bodies and
+answers `GET /health` / `GET /metrics` inline from snapshots the driver
+refreshes every second (health carries the failure-domain state machine:
+"OK" | "retrying" | "degraded" | "recovering").  Wire schema matches the
+reference's axum routes (`http.rs:61-163`): quantity defaults to 1,
+server-side timestamps, engine errors as 500 `{"error": ...}`.
 
 Selectable via `--http-backend native`.
 """

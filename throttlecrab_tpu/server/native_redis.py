@@ -142,14 +142,36 @@ class NativeRedisTransport:
                 raise RuntimeError("native redis driver thread died")
 
     async def stop(self) -> None:
+        import asyncio
+
         self._running = False
-        self._lib.ws_stop(self._h)
-        if self._driver is not None:
-            self._driver.join(timeout=5)
+        loop = asyncio.get_running_loop()
+        # ws_stop is the poison pill: it flips the C++ running flag and
+        # notifies the queue condvar, so a driver parked in
+        # ws_next_batch (whose wait predicate includes !running) wakes
+        # immediately instead of sleeping out its linger timeout.  It
+        # also joins the IO thread — up to ~1 s of epoll_wait — so it
+        # runs on the executor, never the event loop.
+        await loop.run_in_executor(None, self._lib.ws_stop, self._h)
+        driver = self._driver
+        if driver is not None:
+            await loop.run_in_executor(None, driver.join, 5)
+            if driver.is_alive():
+                # Most likely wedged inside a device launch (the one
+                # block ws_stop cannot interrupt).  Leak it loudly —
+                # and skip ws_destroy, which would free wire state the
+                # thread may still touch.
+                log.warning(
+                    "native %s driver thread did not exit within 5 s "
+                    "(stuck in a device launch?); leaking the thread "
+                    "and its wire handle instead of corrupting state",
+                    self.name,
+                )
+                self._leaked = True
 
     def __del__(self):
         h = getattr(self, "_h", None)
-        if h:
+        if h and not getattr(self, "_leaked", False):
             self._lib.ws_destroy(h)
             self._h = None
 
@@ -588,12 +610,19 @@ class NativeRedisTransport:
         )
 
     def _push_metrics(self) -> None:
-        """GET /metrics is served from this snapshot (HTTP protocol; the
-        wire layer answers scrapes without a Python round-trip)."""
-        if self.PROTOCOL != 1 or self.metrics is None:
+        """GET /metrics and GET /health are served from these snapshots
+        (HTTP protocol; the wire layer answers both without a Python
+        round-trip — pushed once per second from the drive loop)."""
+        if self.PROTOCOL != 1:
             return
-        text = self.metrics.export_prometheus().encode()
-        self._lib.ws_set_metrics(self._h, text, len(text))
+        if self.metrics is not None:
+            text = self.metrics.export_prometheus().encode()
+            self._lib.ws_set_metrics(self._h, text, len(text))
+        from .supervisor import supervisor_state
+
+        state = supervisor_state(self.limiter)
+        body = b"OK" if state == "ok" else state.encode()
+        self._lib.ws_set_health(self._h, body, len(body))
 
     def _maybe_sweep(self, now_ns: int, n_ops: int) -> None:
         """Policy state is shared with the asyncio engine — all policy

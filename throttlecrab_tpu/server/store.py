@@ -31,6 +31,26 @@ def create_limiter(config):
     )
 
 
+def create_supervised_limiter(config, limiter, metrics=None):
+    """Wrap the device limiter in the failure-domain supervisor
+    (server/supervisor.py): transient launch/fetch faults retry with
+    bounded backoff, persistent device failure degrades to the host
+    scalar oracle (THROTTLECRAB_SUPERVISOR_MODE=degrade), and recovery
+    re-promotes.  One wrapper supervises every transport, because they
+    all share the same limiter."""
+    from .supervisor import SupervisedLimiter
+
+    return SupervisedLimiter(
+        limiter,
+        retries=config.supervisor_retries,
+        backoff_us=config.supervisor_backoff_us,
+        backoff_max_us=config.supervisor_backoff_max_us,
+        probe_interval_ms=config.supervisor_probe_interval_ms,
+        mode=config.supervisor_mode,
+        metrics=metrics,
+    )
+
+
 def create_front_tier(config, metrics, limiter):
     """Build the front tier (L3.5: exact deny cache + admission
     control) from the THROTTLECRAB_FRONT_* knobs, or None when both
@@ -40,6 +60,12 @@ def create_front_tier(config, metrics, limiter):
 
     from ..front import AdmissionController, DenyCache, FrontTier
     from ..tpu.limiter import limiter_uses_bytes_keys
+
+    # Capability-probe the DEVICE limiter, not a supervision wrapper:
+    # the wrapper's uniform signatures would make a cur-less limiter
+    # look certifiable and resurrect the permanently-empty-cache trap
+    # this probe exists to avoid.
+    limiter = getattr(limiter, "inner", limiter)
 
     # A deny cache can only certify entries when the limiter exposes the
     # exact observed TAT: either the cur tier (collect_cur) or, for

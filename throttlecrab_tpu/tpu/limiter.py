@@ -32,6 +32,7 @@ import numpy as np
 
 from ..core.errors import InternalError, InvalidRateLimit, NegativeQuantity
 from ..core.rate_limiter import RateLimitResult, normalize_now_ns
+from ..faults import maybe_fail
 from .keymap import PyKeyMap
 from .table import BucketTable
 
@@ -336,6 +337,7 @@ class _PendingLaunch:
         self._w32 = w32
 
     def fetch(self) -> list:
+        maybe_fail("fetch")
         out = np.asarray(self._out_dev)
         wire = self._wire
         if self._cur:
@@ -416,6 +418,7 @@ class _PendingWireLaunch:
         self._w32 = w32
 
     def fetch(self) -> list:
+        maybe_fail("fetch")
         out = np.asarray(self._out_dev)
         if self._w32:
             from .kernel import finish_w32
@@ -543,6 +546,7 @@ class TpuRateLimiter(ScalarCompatMixin):
          slots, rank0, is_last0, rounds) = self._prepare_one(
             keys, max_burst, count_per_period, period, quantity, now_ns
         )
+        maybe_fail("launch")
         degen = has_degenerate(valid, emission, tolerance, quantity)
         with_degen = not wire or degen
         from .kernel import cur_wire_safe
@@ -651,6 +655,7 @@ class TpuRateLimiter(ScalarCompatMixin):
             prepare_batch(n, max_burst, count_per_period, period, quantity)
         )
         slots, rank0, is_last0, n_full = self.keymap.resolve(keys, valid)
+        maybe_fail("keymap")
         while n_full:
             if not self.auto_grow:
                 raise InternalError("bucket table full")
@@ -819,6 +824,7 @@ class TpuRateLimiter(ScalarCompatMixin):
             and params_cur_safe
             and self.table.cur_safe
         )
+        maybe_fail("launch")
         out_dev = self.table.check_many_packed(
             packed, now_s,
             with_degen=not wire or any_degen,
@@ -929,6 +935,7 @@ class TpuRateLimiter(ScalarCompatMixin):
             )
         use_cur = use_cur and not use_w32
 
+        maybe_fail("launch")
         out_dev = self.table.check_many_packed(
             stack,
             np.full(K_pad, now_ns, np.int64),

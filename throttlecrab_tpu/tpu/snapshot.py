@@ -21,23 +21,32 @@ from typing import Union
 
 import numpy as np
 
+from ..faults import maybe_fail
+
 FORMAT_VERSION = 2  # v2 adds the per-key `shard` column (v1 loads fine)
 
 _U32 = (1 << 32) - 1
 
 
-def _encode_keys(pairs):
-    """[(key, slot)] → (slots, key bytes + per-key codec metadata)."""
-    slots = []
-    keys = []
+class SnapshotError(ValueError):
+    """A snapshot file is corrupt, truncated, or otherwise unreadable.
+
+    Subclasses ValueError so existing except-ValueError callers keep
+    working; the boot path (server/__main__.py) catches it specifically
+    to apply the THROTTLECRAB_SNAPSHOT_STRICT policy.
+    """
+
+
+def _encode_keys(keys):
+    """keys → (key bytes, per-key is_bytes flag, per-key codec)."""
+    out = []
     key_is_bytes = []
     key_codec = []  # 0 = surrogateescape, 1 = surrogatepass
-    for key, slot in pairs:
-        slots.append(slot)
+    for key in keys:
         is_b = isinstance(key, (bytes, bytearray))
         key_is_bytes.append(is_b)
         if is_b:
-            keys.append(bytes(key))
+            out.append(bytes(key))
             key_codec.append(0)
         else:
             # surrogateescape round-trips keys decoded from raw bytes;
@@ -45,12 +54,82 @@ def _encode_keys(pairs):
             # need surrogatepass — record which codec per key so restore
             # reverses it exactly and one odd key can't lose a snapshot.
             try:
-                keys.append(str(key).encode("utf-8", "surrogateescape"))
+                out.append(str(key).encode("utf-8", "surrogateescape"))
                 key_codec.append(0)
             except UnicodeEncodeError:
-                keys.append(str(key).encode("utf-8", "surrogatepass"))
+                out.append(str(key).encode("utf-8", "surrogatepass"))
                 key_codec.append(1)
-    return slots, keys, key_is_bytes, key_codec
+    return out, key_is_bytes, key_codec
+
+
+def export_state(limiter):
+    """Fetch the limiter's live state host-side, without encoding it.
+
+    Returns ``(keys, slots, shard, tat, expiry, capacity, n_shards)`` —
+    original key objects (str/bytes exactly as the keymap holds them)
+    plus i64 tat/expiry columns.  This is the shared first half of
+    :func:`save_snapshot` and the launch supervisor's degraded-mode
+    seeding (server/supervisor.py): on persistent device failure the
+    supervisor exports this state to seed the host scalar oracle.
+
+    A degraded SupervisedLimiter exports its host oracle's state (the
+    freshest complete view — the device copy is stale the moment the
+    oracle takes over); otherwise the device table is fetched.
+    """
+    local = getattr(limiter, "local", None)
+    if local is not None:  # ClusterLimiter
+        return export_state(local)
+    degraded = getattr(limiter, "export_degraded_state", None)
+    if degraded is not None:  # SupervisedLimiter
+        host = degraded()
+        if host is not None:
+            keys, tats, exps = host
+            n = len(keys)
+            return (
+                list(keys),
+                np.full(n, -1, np.int64),
+                np.zeros(n, np.int32),
+                np.asarray(tats, np.int64),
+                np.asarray(exps, np.int64),
+                int(getattr(limiter, "total_capacity", 1 << 62)),
+                1,
+            )
+        limiter = limiter.inner
+
+    if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
+        # [D, rows, 4] packed i32 — one gather off the mesh.
+        state = np.asarray(limiter.table.state)
+        per_shard = [km.items() for km in limiter.keymaps]
+        keys = [k for p in per_shard for k, _ in p]
+        slots = np.asarray(
+            [s for p in per_shard for _, s in p], np.int64
+        )
+        shard = np.asarray(
+            [d for d, p in enumerate(per_shard) for _ in p], np.int32
+        )
+        rows = state[shard, slots] if len(slots) else np.zeros(
+            (0, 4), np.int32
+        )
+        tat = (rows[:, 1].astype(np.int64) << 32) | (
+            rows[:, 0].astype(np.int64) & _U32
+        )
+        expiry = (rows[:, 3].astype(np.int64) << 32) | (
+            rows[:, 2].astype(np.int64) & _U32
+        )
+        n_shards = int(getattr(limiter, "n_shards", 1))
+    else:
+        tat_col = np.asarray(limiter.table.tat)
+        expiry_col = np.asarray(limiter.table.expiry)
+        items = limiter.keymap.items()
+        keys = [k for k, _ in items]
+        slots = np.asarray([s for _, s in items], np.int64)
+        shard = np.zeros(len(slots), np.int32)
+        tat = tat_col[slots] if len(slots) else np.zeros(0, np.int64)
+        expiry = (
+            expiry_col[slots] if len(slots) else np.zeros(0, np.int64)
+        )
+        n_shards = 1
+    return keys, slots, shard, tat, expiry, limiter.table.capacity, n_shards
 
 
 def _normalize(path: Union[str, Path]) -> Path:
@@ -81,44 +160,10 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
         return save_snapshot(local, path)
 
     path = _normalize(path)
-    if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
-        # [D, rows, 4] packed i32 — one gather off the mesh.
-        state = np.asarray(limiter.table.state)
-        per_shard = [
-            _encode_keys(km.items()) for km in limiter.keymaps
-        ]
-        slots = np.asarray(
-            [s for p in per_shard for s in p[0]], np.int64
-        )
-        shard = np.asarray(
-            [d for d, p in enumerate(per_shard) for _ in p[0]], np.int32
-        )
-        keys = [k for p in per_shard for k in p[1]]
-        key_is_bytes = [b for p in per_shard for b in p[2]]
-        key_codec = [c for p in per_shard for c in p[3]]
-        rows = state[shard, slots] if len(slots) else np.zeros(
-            (0, 4), np.int32
-        )
-        tat = (rows[:, 1].astype(np.int64) << 32) | (
-            rows[:, 0].astype(np.int64) & _U32
-        )
-        expiry = (rows[:, 3].astype(np.int64) << 32) | (
-            rows[:, 2].astype(np.int64) & _U32
-        )
-        capacity = limiter.table.capacity  # per shard
-    else:
-        tat_col = np.asarray(limiter.table.tat)
-        expiry_col = np.asarray(limiter.table.expiry)
-        slots, keys, key_is_bytes, key_codec = _encode_keys(
-            limiter.keymap.items()
-        )
-        slots = np.asarray(slots, np.int64)
-        shard = np.zeros(len(slots), np.int32)
-        tat = tat_col[slots] if len(slots) else np.zeros(0, np.int64)
-        expiry = (
-            expiry_col[slots] if len(slots) else np.zeros(0, np.int64)
-        )
-        capacity = limiter.table.capacity
+    raw_keys, slots, shard, tat, expiry, capacity, n_shards = (
+        export_state(limiter)
+    )
+    keys, key_is_bytes, key_codec = _encode_keys(raw_keys)
 
     # Length-prefixed layout (offsets[n+1] + blob): binary-safe for keys
     # containing any byte, including NUL.
@@ -130,6 +175,7 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
     # snapshot (np.savez_compressed writes the destination in place).
     import os
 
+    maybe_fail("snapshot")
     tmp = path.with_name(path.name + ".tmp")
     with open(tmp, "wb") as f:
         np.savez_compressed(
@@ -138,7 +184,7 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
             capacity=np.int64(capacity),
             slots=slots,
             shard=shard,
-            n_shards=np.int64(getattr(limiter, "n_shards", 1)),
+            n_shards=np.int64(n_shards),
             tat=tat,
             expiry=expiry,
             key_offsets=offsets,
@@ -184,30 +230,70 @@ def load_snapshot(
     if len(limiter) != 0:
         raise ValueError("restore requires an empty limiter")
     path = _normalize(path)
-    with np.load(path) as data:
-        version = int(data["version"])
-        if version not in (1, FORMAT_VERSION):
-            raise ValueError(f"unsupported snapshot version {version}")
-        tat = data["tat"]
-        expiry = data["expiry"]
-        offsets = data["key_offsets"]
-        key_blob = data["key_blob"].tobytes()
-        key_is_bytes = data["key_is_bytes"].astype(bool)
-        key_codec = (
-            data["key_codec"].astype(np.uint8)
-            if "key_codec" in data
-            else np.zeros(len(key_is_bytes), np.uint8)
-        )
-        source_bytes_keys = (
-            bool(data["source_bytes_keys"])
-            if "source_bytes_keys" in data
-            else False
-        )
-        meta = json.loads(data["meta"].tobytes())
+    maybe_fail("snapshot")
+    # Everything below reads attacker-or-corruption-shaped bytes: a
+    # truncated npz raises BadZipFile/EOFError/zlib.error depending on
+    # where the truncation landed, a damaged member raises ValueError,
+    # and a missing column raises KeyError.  All of them must surface
+    # as one typed SnapshotError so the boot path can apply the
+    # THROTTLECRAB_SNAPSHOT_STRICT policy instead of crashing.
+    import zipfile
+    import zlib
+
+    try:
+        with np.load(path) as data:
+            version = int(data["version"])
+            if version not in (1, FORMAT_VERSION):
+                raise SnapshotError(
+                    f"unsupported snapshot version {version}"
+                )
+            tat = data["tat"]
+            expiry = data["expiry"]
+            offsets = data["key_offsets"]
+            key_blob = data["key_blob"].tobytes()
+            key_is_bytes = data["key_is_bytes"].astype(bool)
+            key_codec = (
+                data["key_codec"].astype(np.uint8)
+                if "key_codec" in data
+                else np.zeros(len(key_is_bytes), np.uint8)
+            )
+            source_bytes_keys = (
+                bool(data["source_bytes_keys"])
+                if "source_bytes_keys" in data
+                else False
+            )
+            meta = json.loads(data["meta"].tobytes())
+    except SnapshotError:
+        raise
+    except (
+        OSError,
+        KeyError,
+        ValueError,
+        EOFError,
+        zipfile.BadZipFile,
+        zlib.error,
+        json.JSONDecodeError,
+    ) as e:
+        raise SnapshotError(
+            f"corrupt or unreadable snapshot {path}: {e}"
+        ) from e
 
     n = len(offsets) - 1
-    if meta["n_keys"] != n or len(tat) != n or len(expiry) != n:
-        raise ValueError("corrupt snapshot: array lengths disagree")
+    if (
+        n < 0
+        or meta.get("n_keys") != n
+        or len(tat) != n
+        or len(expiry) != n
+        or len(key_is_bytes) != n
+        or len(key_codec) != n
+    ):
+        raise SnapshotError("corrupt snapshot: array lengths disagree")
+    if n and (
+        int(offsets[0]) != 0
+        or bool((np.diff(offsets) < 0).any())
+        or int(offsets[-1]) != len(key_blob)
+    ):
+        raise SnapshotError("corrupt snapshot: key offsets inconsistent")
 
     # Cross-backend identity translation: str-keyed transports look keys
     # up as str, bytes-keyed (native) keymaps as bytes.  A snapshot from a
