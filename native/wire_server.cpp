@@ -352,6 +352,9 @@ struct WireServer {
     // /health body pushed by the driver (failure-domain state machine:
     // "OK" | "retrying" | "degraded" | "recovering").
     std::string health_text = "OK";
+    // /stats JSON snapshot pushed by the driver (insight tier, L3.75);
+    // the disabled shape until the first push.
+    std::string stats_text = "{\"insight\": {\"enabled\": false}}";
 
     // stats
     std::atomic<uint64_t> n_conns{0}, n_requests{0}, n_inline{0};
@@ -760,6 +763,17 @@ struct WireServer {
                       keep_alive);
             return 1;
         }
+        if (method == "GET" && path == "/stats") {
+            // Insight-tier analytics snapshot (L3.75), answered inline
+            // like /health and /metrics — no Python round trip.
+            std::string text;
+            {
+                std::lock_guard<std::mutex> lk(m_mu);
+                text = stats_text;
+            }
+            send_http(c, 200, "application/json", text, keep_alive);
+            return 1;
+        }
         if (!(method == "POST" && path == "/throttle")) {
             send_http(c, 404, "text/plain", "Not Found", keep_alive);
             return 1;
@@ -975,6 +989,13 @@ void ws_set_health(void* h, const char* text, int64_t len) {
     auto* s = static_cast<WireServer*>(h);
     std::lock_guard<std::mutex> lk(s->m_mu);
     s->health_text.assign(text, len);
+}
+
+// Push the insight tier's /stats JSON snapshot (HTTP protocol).
+void ws_set_stats(void* h, const char* text, int64_t len) {
+    auto* s = static_cast<WireServer*>(h);
+    std::lock_guard<std::mutex> lk(s->m_mu);
+    s->stats_text.assign(text, len);
 }
 
 uint16_t ws_port(void* h) { return static_cast<WireServer*>(h)->port; }

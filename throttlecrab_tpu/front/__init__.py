@@ -38,6 +38,11 @@ class FrontTier:
         self.admission = admission
         self.metrics = metrics
         self.bytes_keys = bytes_keys
+        # Insight tier (L3.75), when attached: cache-served denials are
+        # reported there so /stats totals cover ALL served denials, not
+        # just device-decided ones (the cache exists precisely so the
+        # hottest denials never reach the device).
+        self.insight = None
 
     # ------------------------------------------------------------------ #
 
@@ -71,8 +76,11 @@ class FrontTier:
             k, max_burst, count_per_period, period, quantity, now_ns
         )
         self._flush_stale(stale_before)
-        if hit is not None and self.metrics is not None:
-            self.metrics.record_front_hit()
+        if hit is not None:
+            if self.metrics is not None:
+                self.metrics.record_front_hit()
+            if self.insight is not None:
+                self.insight.record_front_denied((k,))
         return hit
 
     def admit(self, depth: int, peek: bool) -> bool:
@@ -123,8 +131,13 @@ class FrontTier:
             mark_inflight=mark_inflight,
         )
         self._flush_stale(stale_before)
-        if n_hits and self.metrics is not None:
-            self.metrics.record_front_hits(n_hits)
+        if n_hits:
+            if self.metrics is not None:
+                self.metrics.record_front_hits(n_hits)
+            if self.insight is not None:
+                self.insight.record_front_denied(
+                    k for k, r in zip(keys, rows) if r is not None
+                )
         return rows, n_hits
 
     def observe_window(self, rows, now_ns, seq) -> None:
@@ -171,6 +184,22 @@ class FrontTier:
             retry_after_ns=retry_after_ns,
         )
         self._flush_stale(stale_before)
+
+    def prewarm(self, keys) -> int:
+        """Insight-tier feedback: refresh confirmed hot-denied keys to
+        the back of the deny cache's eviction queues (nothing is
+        created — exactness is untouched).  Keys may be unnormalized;
+        returns the number of keys actually refreshed."""
+        if self.deny_cache is None:
+            return 0
+        norm = []
+        for key in keys:
+            k = self._norm_key(key)
+            if k is not None:
+                norm.append(k)
+        if not norm:
+            return 0
+        return self.deny_cache.prewarm(norm)
 
     def on_sweep(self, now_ns: int) -> None:
         if self.deny_cache is None:

@@ -75,6 +75,16 @@ class AdmissionController:
         self._cost_us: float = 0.0  # EWMA per-request decide cost
         self.shed_peek = 0
         self.shed_consume = 0
+        # Insight-tier feedback (L3.75): `hot_concentration` is the
+        # share of recent denials landing on the hot set (set per poll
+        # via set_hot_concentration); `hot_shed_weight` scales how hard
+        # it tightens the PEEK bounds — consuming checks keep their
+        # configured bounds, only advisory probes shed earlier when the
+        # traffic is concentrated abuse.  Weight 0 (the default and the
+        # THROTTLECRAB_INSIGHT=0 state) reproduces today's behavior
+        # exactly.
+        self.hot_concentration = 0.0
+        self.hot_shed_weight = 0.0
 
     # ------------------------------------------------------------------ #
 
@@ -96,10 +106,24 @@ class AdmissionController:
 
     # ------------------------------------------------------------------ #
 
+    def set_hot_concentration(self, frac: float) -> None:
+        """Feed the insight tier's hot-set concentration (clamped to
+        [0, 1]); no lock needed — a float store is atomic and admit()
+        tolerates any interleaving."""
+        self.hot_concentration = min(max(float(frac), 0.0), 1.0)
+
     def admit(self, depth: int, peek: bool) -> bool:
         """Admit a new arrival given `depth` requests already pending?
         Counts the shed when refusing."""
         frac = self.peek_frac if peek else 1.0
+        if peek and self.hot_shed_weight:
+            # Concentrated abuse: tighten the peek bounds so advisory
+            # probes yield headroom to the consuming checks absorbing
+            # the attack.  Floor at 10% so peeks are throttled, never
+            # starved outright.
+            frac *= max(
+                1.0 - self.hot_shed_weight * self.hot_concentration, 0.1
+            )
         over = False
         if self.max_pending and depth >= self.max_pending * frac:
             over = True

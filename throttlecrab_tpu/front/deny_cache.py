@@ -610,6 +610,35 @@ class DenyCache:
         with self._lock:
             self._invalidate_key(key)
 
+    def prewarm(self, keys) -> int:
+        """Refresh confirmed-hot keys against FIFO eviction (the
+        insight tier's feedback loop): every live entry and write
+        record for `keys` moves to the END of its eviction queue, so
+        under cache pressure the hottest abuse keys — the ones the
+        cache pays off most for — are the last evicted.  Exactness is
+        untouched: nothing is created, only re-ordered; a key with no
+        certified state is a no-op.  Returns the number of refreshed
+        keys."""
+        n = 0
+        with self._lock:
+            records = self._records
+            entries = self._entries
+            for key in keys:
+                touched = False
+                rec = records.pop(key, None)
+                if rec is not None:
+                    records[key] = rec
+                    touched = True
+                for pq in self._by_key.get(key, ()):
+                    k = (key, pq)
+                    e = entries.pop(k, None)
+                    if e is not None:
+                        entries[k] = e
+                        touched = True
+                if touched:
+                    n += 1
+        return n
+
     def on_sweep(self, now_ns: int) -> int:
         """Expiry sweep ran on the table at `now_ns`: drop every entry
         whose bucket it vacated (the slot is gone even for a later

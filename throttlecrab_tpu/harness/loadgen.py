@@ -21,7 +21,74 @@ import time
 from dataclasses import dataclass, field
 from typing import List
 
-from .workload import Workload, make_keys
+from .workload import Workload, flash_crowd_hot_sets, make_keys
+
+
+@dataclass
+class StatsProbe:
+    """GET /stats polling alongside a load run (--stats): counts polls
+    and measures hot-key detection latency — the wall time from the
+    flash-crowd pattern's hot-set shift until a post-shift hot key
+    first appears in the insight tier's top_denied list."""
+
+    polls: int = 0
+    errors: int = 0
+    shift_t: float = -1.0
+    detection_latency_s: float = -1.0
+
+    def summary(self) -> dict:
+        return {
+            "polls": self.polls,
+            "errors": self.errors,
+            "hot_detection_latency_s": round(self.detection_latency_s, 3),
+        }
+
+
+async def _get_stats(host: str, port: int) -> dict:
+    """One GET /stats over a throwaway connection (Connection: close)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(
+            b"GET /stats HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+        )
+        await writer.drain()
+        # Connection: close — read to EOF so a body split across TCP
+        # segments never truncates the JSON.
+        chunks = []
+        while True:
+            chunk = await reader.read(1 << 16)
+            if not chunk:
+                break
+            chunks.append(chunk)
+        _head, _, body = b"".join(chunks).partition(b"\r\n\r\n")
+        return json.loads(body)
+    finally:
+        writer.close()
+
+
+async def stats_poller(
+    host: str, port: int, probe: StatsProbe, hot_b, stop: asyncio.Event,
+    interval: float = 0.2,
+) -> None:
+    while not stop.is_set():
+        try:
+            doc = await _get_stats(host, port)
+            probe.polls += 1
+            top = {d.get("key") for d in doc.get("top_denied", ())}
+            if (
+                probe.detection_latency_s < 0
+                and probe.shift_t >= 0
+                and top & hot_b
+            ):
+                probe.detection_latency_s = (
+                    time.perf_counter() - probe.shift_t
+                )
+        except Exception:
+            probe.errors += 1
+        try:
+            await asyncio.wait_for(stop.wait(), interval)
+        except asyncio.TimeoutError:
+            pass
 
 
 @dataclass
@@ -39,6 +106,8 @@ class PerfResult:
     _consecutive_errors: int = field(default=0, repr=False)
     first_error_s: float = -1.0
     last_recovery_s: float = -1.0
+    # GET /stats polling results (--stats; a StatsProbe or None).
+    stats_probe: object = field(default=None, repr=False)
 
     def track_outcome(self, is_error: bool, t_s: float) -> None:
         """Feed per-request outcomes (in completion order) for the
@@ -94,6 +163,28 @@ class PerfResult:
             "p99_ms": round(self.percentile_ms(0.99), 3),
             "p99_9_ms": round(self.percentile_ms(0.999), 3),
         }
+
+
+def _make_barrier(n: int):
+    """asyncio.Barrier, or a minimal event-based stand-in on Python
+    3.10 (Barrier landed in 3.11) — the start gate only ever does one
+    all-workers rendezvous."""
+    if hasattr(asyncio, "Barrier"):
+        return asyncio.Barrier(n)
+
+    class _OneShotBarrier:
+        def __init__(self, parties: int) -> None:
+            self._parties = parties
+            self._count = 0
+            self._event = asyncio.Event()
+
+        async def wait(self) -> None:
+            self._count += 1
+            if self._count >= self._parties:
+                self._event.set()
+            await self._event.wait()
+
+    return _OneShotBarrier(n)
 
 
 # ---------------------------------------------------------------- clients #
@@ -314,23 +405,40 @@ async def run_perf_test(
     target_rps: float = 0.0,
     pipeline: int = 1,
     chaos: bool = False,
+    stats_port: int = 0,
 ) -> PerfResult:
     """Barrier-synchronized workers, pre-generated keys
     (perf_test_multi_transport.rs:48-127).
 
     `pipeline` > 1 (RESP only) sends that many commands per write before
     reading the responses; recorded latency is then per *window* — the
-    time until the whole window's responses are parsed."""
+    time until the whole window's responses are parsed.
+
+    `stats_port` > 0 polls GET /stats (the insight tier) every 200 ms
+    during the run and, with the flash-crowd key pattern, reports the
+    hot-key detection latency in result.stats_probe."""
     if pipeline > 1 and transport != "redis":
         raise ValueError("--pipeline requires the redis transport")
     clients = [CLIENTS[transport](host, port) for _ in range(workers)]
     await asyncio.gather(*(c.connect() for c in clients))
 
+    probe = None
+    stats_stop = None
+    stats_task = None
+    if stats_port:
+        probe = StatsProbe()
+        _, hot_b = flash_crowd_hot_sets(key_space)
+        stats_stop = asyncio.Event()
+        stats_task = asyncio.create_task(
+            stats_poller(host, stats_port, probe, hot_b, stats_stop)
+        )
+    shift = requests_per_worker // 2
+
     all_keys = [
         make_keys(key_pattern, requests_per_worker, key_space, seed=w)
         for w in range(workers)
     ]
-    barrier = asyncio.Barrier(workers)
+    barrier = _make_barrier(workers)
     result = PerfResult(transport, 0, 0.0, 0, 0, 0)
 
     def tally(allowed) -> None:
@@ -360,6 +468,12 @@ async def run_perf_test(
         if pipeline > 1:
             for start in range(0, len(keys), pipeline):
                 window = keys[start : start + pipeline]
+                if (
+                    probe is not None
+                    and probe.shift_t < 0
+                    and start <= shift < start + pipeline
+                ):
+                    probe.shift_t = time.perf_counter()
                 t0 = time.perf_counter()
                 try:
                     outcomes = await client.throttle_many(
@@ -379,6 +493,8 @@ async def run_perf_test(
                     tally(allowed)
             return
         for done, (key, delay) in enumerate(zip(keys, wl.delays())):
+            if probe is not None and done == shift and probe.shift_t < 0:
+                probe.shift_t = time.perf_counter()
             if delay > 0:
                 await asyncio.sleep(delay)
             t0 = time.perf_counter()
@@ -403,6 +519,13 @@ async def run_perf_test(
     await asyncio.gather(*(worker(w) for w in range(workers)))
     result.elapsed_s = time.perf_counter() - t_start
     result.total_requests = workers * requests_per_worker
+    if stats_task is not None:
+        # Give the poller one more cadence to catch a shift that
+        # happened in the run's final windows, then stop it.
+        await asyncio.sleep(0.25)
+        stats_stop.set()
+        await stats_task
+        result.stats_probe = probe
     await asyncio.gather(*(c.close() for c in clients))
     return result
 
@@ -422,7 +545,18 @@ def main(argv=None) -> int:
                    help="requests per worker")
     p.add_argument("--key-pattern", default="random",
                    choices=["sequential", "random", "zipfian",
-                            "user-resource", "hotkey-abuse", "chaos"])
+                            "user-resource", "hotkey-abuse",
+                            "flash-crowd", "chaos"])
+    p.add_argument("--stats", action="store_true",
+                   help="poll GET /stats (the insight tier) every "
+                        "200 ms during the run and report hot-key "
+                        "detection latency — with --key-pattern "
+                        "flash-crowd, the wall time from the hot-set "
+                        "shift until a post-shift hot key appears in "
+                        "top_denied")
+    p.add_argument("--stats-port", type=int, default=0,
+                   help="port serving GET /stats (default: the HTTP "
+                        "port)")
     p.add_argument("--chaos", action="store_true",
                    help="chaos run against a THROTTLECRAB_FAULTS-armed "
                         "server: drives the 'chaos' key pattern (hot "
@@ -456,15 +590,21 @@ def main(argv=None) -> int:
         return 2
     ports = {"http": args.port, "grpc": args.grpc_port,
              "redis": args.redis_port}
+    if args.stats and args.procs > 1:
+        print("error: --stats requires --procs 1", file=sys.stderr)
+        return 2
     for transport in transports:
         key_pattern = args.key_pattern
         if args.chaos and key_pattern == "random":
             key_pattern = "chaos"  # the chaos default; explicit wins
+        if args.stats and key_pattern == "random":
+            key_pattern = "flash-crowd"  # the --stats default
         kwargs = dict(
             burst=args.burst, count=args.count, period=args.period,
             key_pattern=key_pattern, key_space=args.key_space,
             workload=args.workload, target_rps=args.target_rps,
             pipeline=args.pipeline, chaos=args.chaos,
+            stats_port=(args.stats_port or args.port) if args.stats else 0,
         )
         if args.procs > 1:
             result = run_multiproc(
@@ -485,6 +625,8 @@ def main(argv=None) -> int:
             summary["procs"] = args.procs
         if args.chaos:
             summary["chaos"] = result.chaos_summary()
+        if result.stats_probe is not None:
+            summary["stats"] = result.stats_probe.summary()
         print(json.dumps(summary))
     return 0
 

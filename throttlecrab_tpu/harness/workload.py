@@ -84,6 +84,26 @@ def make_keys(
         cold = rng.integers(n_hot, max(key_space, n_hot + 1), n_requests)
         is_hot = rng.random(n_requests) < 0.9
         ids = np.where(is_hot, hot, cold)
+    elif pattern == "flash-crowd":
+        # Sudden hot-set shift (the insight tier's detection target):
+        # the first half of the run hammers hot set A, then the crowd
+        # moves — the second half hammers a DISJOINT hot set B with the
+        # same ~90% concentration over a benign random tail.  A
+        # telemetry loop that only knows cumulative counters keeps
+        # reporting set A long after the attack moved; the harness's
+        # --stats flag measures how fast GET /stats surfaces set B
+        # (see flash_crowd_hot_sets for the set definitions).
+        n_hot = max(key_space // 1000, 1)
+        shift = n_requests // 2
+        hot_a = rng.integers(0, n_hot, n_requests)
+        hot_b = rng.integers(n_hot, 2 * n_hot, n_requests)
+        cold = rng.integers(
+            2 * n_hot, max(key_space, 2 * n_hot + 1), n_requests
+        )
+        pos = np.arange(n_requests)
+        hot = np.where(pos < shift, hot_a, hot_b)
+        is_hot = rng.random(n_requests) < 0.9
+        ids = np.where(is_hot, hot, cold)
     elif pattern == "chaos":
         # The chaos-run companion (harness --chaos) for a server armed
         # with THROTTLECRAB_FAULTS: half hot-key abuse (exercises the
@@ -103,3 +123,15 @@ def make_keys(
     else:
         raise ValueError(f"unknown key pattern: {pattern!r}")
     return [f"key:{i}" for i in ids]
+
+
+def flash_crowd_hot_sets(key_space: int):
+    """(set_a, set_b) key strings of the flash-crowd pattern's two hot
+    sets — the shift happens at n_requests // 2 of every worker's
+    stream.  The load generator's --stats poller uses set_b to measure
+    hot-key detection latency."""
+    n_hot = max(key_space // 1000, 1)
+    return (
+        {f"key:{i}" for i in range(n_hot)},
+        {f"key:{i}" for i in range(n_hot, 2 * n_hot)},
+    )

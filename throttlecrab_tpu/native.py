@@ -182,6 +182,9 @@ def _build_wire() -> Optional[ctypes.CDLL]:
     lib.ws_set_health.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
     ]
+    lib.ws_set_stats.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+    ]
     lib.ws_port.restype = ctypes.c_uint16
     lib.ws_port.argtypes = [ctypes.c_void_p]
     lib.ws_stop.argtypes = [ctypes.c_void_p]

@@ -19,6 +19,7 @@ from .metrics import Metrics
 from .store import (
     create_cleanup_policy,
     create_front_tier,
+    create_insight,
     create_limiter,
     create_supervised_limiter,
 )
@@ -54,6 +55,7 @@ def build_transports(config: Config, engine, metrics):
                     limiter_lock=engine.limiter_lock,
                     now_fn=engine.now_fn,
                     front=engine.front,
+                    insight=engine.insight,
                 )
             )
         else:
@@ -92,6 +94,7 @@ def build_transports(config: Config, engine, metrics):
                     limiter_lock=engine.limiter_lock,
                     now_fn=engine.now_fn,
                     front=engine.front,
+                    insight=engine.insight,
                 )
             )
         else:
@@ -214,6 +217,17 @@ async def run_server(config: Config) -> None:
     # Re-promotion rewrites bucket state out from under cached denials:
     # the supervisor needs the front's on_restore hook.
     supervisor.front = front
+    # Insight tier (L3.75): device-resident analytics + the deny-cache
+    # and admission feedback loop.  The supervisor feeds it from the
+    # host oracle while degraded so /stats stays truthful.
+    insight = create_insight(config, metrics, device_limiter, front)
+    supervisor.insight = insight
+    if cluster_nodes and insight is not None:
+        # In cluster mode the device is serialized by the cluster's
+        # device lock (the RPC listener decides under it, bypassing
+        # engine.limiter_lock); the insight poll must use the same one
+        # or it races the RPC path's donated state buffers.
+        insight.poll_lock = limiter.device_lock
     engine = BatchingEngine(
         limiter,
         batch_size=config.batch_size,
@@ -223,6 +237,7 @@ async def run_server(config: Config) -> None:
         metrics=metrics,
         profile_dir=config.profile_dir or None,
         front=front,
+        insight=insight,
     )
     transports = build_transports(config, engine, metrics)
     if cluster_nodes:

@@ -134,6 +134,33 @@ _SPEC = [
     ("cluster_breaker_cooldown_ms",
      "THROTTLECRAB_CLUSTER_BREAKER_COOLDOWN_MS", 1000, int,
      "Circuit-breaker cooldown before the next probe (milliseconds)"),
+    # --- insight tier (L3.75: device-resident traffic analytics) --------
+    ("insight", "THROTTLECRAB_INSIGHT", True, bool,
+     "Insight tier: device-resident traffic analytics riding every "
+     "decision launch, GET /stats, and the deny-cache/admission "
+     "feedback loop (env 0 disables; the decision path is then "
+     "bit-identical to the subsystem absent)"),
+    ("insight_topk", "THROTTLECRAB_INSIGHT_TOPK", 64, int,
+     "Device-side partial top-K size over the denied-hit column"),
+    ("insight_sketch", "THROTTLECRAB_INSIGHT_SKETCH", 4096, int,
+     "Host space-saving sketch capacity (hot-key tracking, keyed by "
+     "real key bytes)"),
+    ("insight_window_s", "THROTTLECRAB_INSIGHT_WINDOW_S", 10, int,
+     "Sliding window for the /stats allowed/denied rates (seconds)"),
+    ("insight_poll_ms", "THROTTLECRAB_INSIGHT_POLL_MS", 1000, int,
+     "Cadence of the throttled device insight poll (accumulator fetch "
+     "+ top-K launch; milliseconds)"),
+    ("insight_decay_s", "THROTTLECRAB_INSIGHT_DECAY_S", 60, int,
+     "Halving cadence of the device denied-hit column so the top-K "
+     "tracks the current hot set (seconds; 0 never decays)"),
+    ("insight_prewarm", "THROTTLECRAB_INSIGHT_PREWARM", 64, int,
+     "Max confirmed hot-denied keys refreshed into the deny cache's "
+     "eviction queue per poll (0 disables the prewarm feedback)"),
+    ("insight_hot_denies", "THROTTLECRAB_INSIGHT_HOT_DENIES", 100, int,
+     "Sketch count at which a denied key counts as confirmed-hot"),
+    ("insight_shed_weight", "THROTTLECRAB_INSIGHT_SHED_WEIGHT", 0.0, float,
+     "Scale admission-control peek shedding by hot-set concentration "
+     "(0 disables; 1 = full tightening under pure abuse traffic)"),
 ]
 
 
@@ -186,6 +213,15 @@ class Config:
     cluster_connect_timeout_ms: int = 1000
     cluster_breaker_failures: int = 3
     cluster_breaker_cooldown_ms: int = 1000
+    insight: bool = True
+    insight_topk: int = 64
+    insight_sketch: int = 4096
+    insight_window_s: int = 10
+    insight_poll_ms: int = 1000
+    insight_decay_s: int = 60
+    insight_prewarm: int = 64
+    insight_hot_denies: int = 100
+    insight_shed_weight: float = 0.0
 
     @classmethod
     def from_env_and_args(
@@ -251,6 +287,21 @@ class Config:
             raise ConfigError("supervisor backoffs must be >= 0")
         if self.supervisor_probe_interval_ms <= 0:
             raise ConfigError("supervisor_probe_interval_ms must be > 0")
+        if self.insight_topk <= 0 or self.insight_sketch <= 0:
+            raise ConfigError("insight_topk/insight_sketch must be > 0")
+        if self.insight_window_s <= 0 or self.insight_poll_ms <= 0:
+            raise ConfigError(
+                "insight_window_s/insight_poll_ms must be > 0"
+            )
+        if self.insight_decay_s < 0:
+            raise ConfigError("insight_decay_s must be >= 0")
+        if self.insight_prewarm < 0 or self.insight_hot_denies < 1:
+            raise ConfigError(
+                "insight_prewarm must be >= 0 and "
+                "insight_hot_denies >= 1"
+            )
+        if not 0.0 <= self.insight_shed_weight <= 1.0:
+            raise ConfigError("insight_shed_weight must be in [0, 1]")
         if self.faults:
             from ..faults import parse_spec
 

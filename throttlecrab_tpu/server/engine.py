@@ -75,6 +75,7 @@ class BatchingEngine:
         profile_launches: int = 50,
         max_scan_depth: int = 16,
         front=None,
+        insight=None,
     ) -> None:
         """`limiter` is a TpuRateLimiter / ShardedTpuRateLimiter (or any
         object with rate_limit_batch + sweep).  `now_fn` injects time for
@@ -84,12 +85,17 @@ class BatchingEngine:
         through its admission control (shed with OverloadError instead
         of queueing unboundedly) and its exact deny cache (repeat
         denials answered without a device launch) before they ever
-        reach the pending queue."""
+        reach the pending queue.  `insight` is an optional
+        insight.InsightTier (L3.75): the engine drives its throttled
+        device poll between flushes (on the executor — the poll fetch
+        synchronizes with in-flight launches) and serves its document
+        on GET /stats."""
         import threading
         import time
 
         self.limiter = limiter
         self.front = front
+        self.insight = insight
         # Serializes device access with native transports that drive the
         # same limiter from their own threads (server/native_redis.py).
         self.limiter_lock = threading.Lock()
@@ -536,6 +542,17 @@ class BatchingEngine:
     # ------------------------------------------------------------------ #
 
     async def _maybe_sweep(self, now_ns: int, n_ops: int) -> None:
+        insight = self.insight
+        if insight is not None and insight.poll_due(now_ns):
+            # Throttled insight poll (~1/s): the accumulator fetch and
+            # top-K launch block on the device, so it runs on the
+            # executor, under the lock that serializes device access
+            # (the limiter lock here; the cluster device lock when the
+            # tier's poll_lock overrides it).
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, insight.maybe_poll, now_ns, self.limiter_lock
+            )
         policy = self.cleanup_policy
         if policy is None:
             return

@@ -2,8 +2,9 @@
 
 Same wire surface as the reference's axum router (`http.rs:103-163`):
 `POST /throttle` with `{key, max_burst, count_per_period, period, quantity?}`
-(quantity defaults to 1, `http.rs:135`), `GET /health` returning "OK", and
-`GET /metrics` returning Prometheus text.  Timestamps are always server-side
+(quantity defaults to 1, `http.rs:135`), `GET /health` returning "OK",
+`GET /metrics` returning Prometheus text, and `GET /stats` returning the
+insight tier's JSON analytics document (L3.75; no reference equivalent).  Timestamps are always server-side
 (`http.rs:127-128`); client-supplied timestamps are ignored by design.
 Errors return 500 with `{"error": ...}` like the reference's error handler
 (`http.rs:148-157`).
@@ -32,7 +33,7 @@ MAX_BODY_BYTES = 1 << 20
 
 
 class HttpTransport(ConnTrackingMixin):
-    """`POST /throttle` + `GET /health` + `GET /metrics`."""
+    """`POST /throttle` + `GET /health` + `GET /metrics` + `GET /stats`."""
 
     name = "http"
 
@@ -152,6 +153,21 @@ class HttpTransport(ConnTrackingMixin):
                 self.metrics.export_prometheus().encode(),
                 "text/plain; version=0.0.4",
             )
+        if method == "GET" and path == "/stats":
+            # Insight-tier JSON (L3.75): traffic totals, windowed
+            # rates, top denied keys, hot-set concentration.  With the
+            # tier disabled the shape still answers (enabled: false)
+            # so pollers need no probe logic.
+            insight = getattr(self.engine, "insight", None)
+            if insight is None:
+                payload = json.dumps(
+                    {"insight": {"enabled": False}}
+                ).encode()
+            else:
+                payload = insight.stats_json(
+                    state=self.engine.health_state()
+                ).encode()
+            return 200, payload, "application/json"
         return 404, b"Not Found", "text/plain"
 
     async def _handle_throttle(self, body: bytes):

@@ -53,39 +53,51 @@ METRIC_NAMES = (
     "throttlecrab_tpu_supervisor_repromotes",
     "throttlecrab_cluster_forwarded_total",
     "throttlecrab_cluster_failed_total",
+    # Insight tier (L3.75, insight/).
+    "throttlecrab_tpu_insight_allowed_rate",
+    "throttlecrab_tpu_insight_denied_rate",
+    "throttlecrab_tpu_insight_hot_concentration",
+    "throttlecrab_tpu_insight_tracked_keys",
+    "throttlecrab_tpu_insight_prewarmed_total",
+    "throttlecrab_tpu_insight_polls",
 )
 
 
 class TopDeniedKeys:
-    """Bounded denied-key counter (metrics.rs:24-76).
-
-    Grows to 3x max_keys, then sorts by count and truncates back — the
-    reference's amortized grow-then-prune strategy, kept verbatim including
-    the 256-byte key cap.
-    """
+    """Bounded denied-key counter (metrics.rs:24-76), backed by the
+    insight tier's space-saving sketch (insight/sketch.py) — one
+    implementation for the metrics leaderboard and the hot-key
+    analytics.  The sketch keeps the reference's amortized
+    grow-to-3x-then-prune shape and is numerically identical to the old
+    dict tracker while distinct denied keys fit `max_keys` (the
+    compaction floor stays 0); past that it adds the space-saving error
+    bound instead of silently losing history.  The 256-byte key cap is
+    kept verbatim."""
 
     def __init__(self, max_keys: int) -> None:
+        from ..insight.sketch import SpaceSavingSketch
+
         self.max_keys = max_keys
-        self.counts: Dict[str, int] = {}
+        self._sketch = (
+            SpaceSavingSketch(max_keys) if max_keys > 0 else None
+        )
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        """Live estimate map (diagnostics/tests)."""
+        if self._sketch is None:
+            return {}
+        return self._sketch.counts
 
     def record(self, key: str) -> None:
-        if self.max_keys == 0:
+        if self._sketch is None:
             return
-        key = key[:MAX_KEY_LENGTH]
-        self.counts[key] = self.counts.get(key, 0) + 1
-        if len(self.counts) > self.max_keys * 3:
-            self._prune()
-
-    def _prune(self) -> None:
-        top = sorted(self.counts.items(), key=lambda kv: -kv[1])[
-            : self.max_keys
-        ]
-        self.counts = dict(top)
+        self._sketch.record(key[:MAX_KEY_LENGTH])
 
     def top(self) -> List[Tuple[str, int]]:
-        return sorted(self.counts.items(), key=lambda kv: -kv[1])[
-            : self.max_keys
-        ]
+        if self._sketch is None:
+            return []
+        return self._sketch.top(self.max_keys)
 
 
 class Metrics:
@@ -129,6 +141,8 @@ class Metrics:
         self.supervisor_degrades = 0
         self.supervisor_repromotes = 0
         self._engine_state = None
+        # Insight tier (L3.75).
+        self._insight_stats = None
 
     @classmethod
     def builder(cls) -> "MetricsBuilder":
@@ -254,6 +268,11 @@ class Metrics:
         """`provider()` -> {"deny_cache_size": n}; exported as gauges
         (FrontTier.stats)."""
         self._front_stats = provider
+
+    def set_insight_stats_provider(self, provider) -> None:
+        """`provider()` -> InsightTier.metric_stats(); exported as the
+        throttlecrab_tpu_insight_* gauges (zeros when absent)."""
+        self._insight_stats = provider
 
     def set_cluster_stats_provider(self, provider) -> None:
         """`provider()` -> {peer_addr: {"forwarded": n, "failed": n}};
@@ -426,6 +445,46 @@ class Metrics:
             "Recoveries that re-promoted host state onto the device",
             "counter",
             self.supervisor_repromotes,
+        )
+        # Insight tier (L3.75, insight/).
+        ins = self._insight_stats() if self._insight_stats else {}
+        metric(
+            "throttlecrab_tpu_insight_allowed_rate",
+            "Allowed decisions/s over the insight window",
+            "gauge",
+            ins.get("allowed_rate", 0),
+        )
+        metric(
+            "throttlecrab_tpu_insight_denied_rate",
+            "Denied decisions/s over the insight window",
+            "gauge",
+            ins.get("denied_rate", 0),
+        )
+        metric(
+            "throttlecrab_tpu_insight_hot_concentration",
+            "Share of recent denials landing on the device top-K "
+            "hot set",
+            "gauge",
+            ins.get("hot_concentration", 0),
+        )
+        metric(
+            "throttlecrab_tpu_insight_tracked_keys",
+            "Keys tracked by the space-saving hot-key sketch",
+            "gauge",
+            ins.get("tracked_keys", 0),
+        )
+        metric(
+            "throttlecrab_tpu_insight_prewarmed_total",
+            "Hot-denied keys refreshed into the deny cache by the "
+            "insight feedback loop",
+            "counter",
+            ins.get("prewarmed_total", 0),
+        )
+        metric(
+            "throttlecrab_tpu_insight_polls",
+            "Device insight polls (accumulator fetch + top-K launch)",
+            "counter",
+            ins.get("polls", 0),
         )
         provider = getattr(self, "_cluster_stats", None)
         if provider is not None:

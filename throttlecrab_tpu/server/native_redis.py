@@ -59,6 +59,7 @@ class NativeRedisTransport:
         now_fn=None,
         max_scan_depth: int = 16,
         front=None,
+        insight=None,
     ) -> None:
         lib = get_wire_lib()
         if lib is None:
@@ -68,6 +69,11 @@ class NativeRedisTransport:
         self.port = port
         self.limiter = limiter
         self.metrics = metrics
+        # Insight tier (L3.75): this driver thread runs its throttled
+        # device poll between windows and pushes the /stats snapshot
+        # into the C++ wire layer (HTTP protocol) alongside
+        # health/metrics.
+        self.insight = insight
         # Front tier (L3.5): shared with the asyncio engine, so a deny
         # cached on one transport serves (and is invalidated by) all of
         # them.  The lookup runs in this driver BEFORE batch prep —
@@ -546,6 +552,10 @@ class NativeRedisTransport:
             tot_errors += n_e
             denied_keys.extend(dk)
             any_launch = any_launch or res is not None
+        if self.insight is not None:
+            # Throttled (~1/s) insight poll; this driver thread may
+            # block on the device, exactly like its decide launches.
+            self.insight.maybe_poll(now_ns, self.limiter_lock)
         if self.metrics is not None and (
             any_launch or tot_errors
         ):
@@ -610,9 +620,10 @@ class NativeRedisTransport:
         )
 
     def _push_metrics(self) -> None:
-        """GET /metrics and GET /health are served from these snapshots
-        (HTTP protocol; the wire layer answers both without a Python
-        round-trip — pushed once per second from the drive loop)."""
+        """GET /metrics, GET /health and GET /stats are served from
+        these snapshots (HTTP protocol; the wire layer answers all
+        three without a Python round-trip — pushed once per second from
+        the drive loop)."""
         if self.PROTOCOL != 1:
             return
         if self.metrics is not None:
@@ -623,6 +634,9 @@ class NativeRedisTransport:
         state = supervisor_state(self.limiter)
         body = b"OK" if state == "ok" else state.encode()
         self._lib.ws_set_health(self._h, body, len(body))
+        if self.insight is not None:
+            stats = self.insight.stats_json(state=state).encode()
+            self._lib.ws_set_stats(self._h, stats, len(stats))
 
     def _maybe_sweep(self, now_ns: int, n_ops: int) -> None:
         """Policy state is shared with the asyncio engine — all policy

@@ -28,6 +28,9 @@ def create_limiter(config):
     return TpuRateLimiter(
         capacity=config.store_capacity,
         keymap=config.keymap,
+        # Insight tier (L3.75): arm the device analytics accumulators
+        # at build time — they ride every decision launch.
+        insight=getattr(config, "insight", False),
     )
 
 
@@ -99,6 +102,41 @@ def create_front_tier(config, metrics, limiter):
     if metrics is not None:
         metrics.set_front_stats_provider(front.stats)
     return front
+
+
+def create_insight(config, metrics, limiter, front):
+    """Build the insight tier (L3.75: device-resident traffic
+    analytics + the deny-cache/admission feedback loop) from the
+    THROTTLECRAB_INSIGHT_* knobs, or None when disabled or the limiter
+    cannot carry it (sharded/cluster tables have no single insight
+    column today — the kill-switch path, exact pre-insight behavior).
+    """
+    if not config.insight:
+        return None
+    from ..insight import InsightTier
+
+    dev = getattr(limiter, "inner", limiter)
+    table = getattr(dev, "table", None)
+    if table is None or not getattr(table, "insight", False):
+        return None
+    insight = InsightTier(
+        limiter=dev,
+        sketch_capacity=config.insight_sketch,
+        topk=config.insight_topk,
+        window_s=config.insight_window_s,
+        poll_ms=config.insight_poll_ms,
+        decay_s=config.insight_decay_s,
+        prewarm=config.insight_prewarm,
+        hot_denies=config.insight_hot_denies,
+        shed_weight=config.insight_shed_weight,
+        front=front,
+    )
+    if metrics is not None:
+        metrics.set_insight_stats_provider(insight.metric_stats)
+    # Pay the poll ops' jit compiles at boot, not inside the first
+    # serving flush (InsightTier.prime docstring has the numbers).
+    insight.prime()
+    return insight
 
 
 def create_cleanup_policy(config) -> CleanupPolicy:

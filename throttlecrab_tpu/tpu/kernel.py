@@ -120,7 +120,8 @@ def pack_state(tat, expiry):
 
 
 def unpack_state(state):
-    """i32[..., 4] rows → (tat i64[...], expiry i64[...])."""
+    """i32[..., W] rows → (tat i64[...], expiry i64[...]); extra
+    columns (the insight-widened layout) are ignored."""
     def join(lo, hi):
         return (hi.astype(jnp.int64) << 32) | (lo.astype(jnp.int64) & _U32)
 
@@ -128,6 +129,28 @@ def unpack_state(state):
         join(state[..., 0], state[..., 1]),
         join(state[..., 2], state[..., 3]),
     )
+
+
+# Insight-widened row: [tat_lo, tat_hi, exp_lo, exp_hi, deny_lo,
+# deny_hi] — the per-slot denied-hit counter lives INSIDE the packed
+# state row so the decision path's one row gather + one row scatter
+# maintain it for free (scatter cost is per row, not per column —
+# that's why the table is packed rows in the first place).
+INS_WIDTH = 6
+
+
+def unpack_deny(state):
+    """Denied-hit counter column of insight-widened rows (i64[...])."""
+    return (state[..., 5].astype(jnp.int64) << 32) | (
+        state[..., 4].astype(jnp.int64) & _U32
+    )
+
+
+def _split_cols(x):
+    """i64[...] → i32[..., 2] lo/hi column pair."""
+    lo = (x & _U32).astype(jnp.uint32).astype(jnp.int32)
+    hi = (x >> 32).astype(jnp.int32)
+    return jnp.stack([lo, hi], axis=-1)
 
 
 def pack_requests(slots, rank, is_last, emission, tolerance, quantity, valid):
@@ -413,14 +436,27 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False,
     (slots, rank, is_last, emission, tolerance, quantity, valid, now) = batch
     N = state.shape[0]
     now = now.astype(jnp.int64)
+    # Insight-widened rows (INS_WIDTH: the per-slot denied-hit counter
+    # rides columns 4/5 of the SAME packed row, so its maintenance is
+    # absorbed by the one gather + one scatter the decision path already
+    # pays — measured free on the CPU backend, where an extra scatter
+    # op would cost ~40% of the whole launch).  Static shape ⇒ the
+    # plain 4-wide table compiles the identical graph as before.
+    ins = state.shape[-1] > 4
+    # The Pallas DMA kernels move fixed 4-wide rows; insight-widened
+    # tables take the plain gather/scatter (enable_insight documents
+    # the exclusion).
+    use_pallas = _pallas_rows() and not ins
 
     s = jnp.clip(slots, 0, N - 1).astype(jnp.int32)
-    if _pallas_rows():
+    if use_pallas:
         from . import pallas_ops
 
-        stored_tat, stored_exp = unpack_state(pallas_ops.row_gather(state, s))
+        rows_g = pallas_ops.row_gather(state, s)
     else:
-        stored_tat, stored_exp = unpack_state(state[s])
+        rows_g = state[s]
+    stored_tat, stored_exp = unpack_state(rows_g)
+    stored_deny = unpack_deny(rows_g) if ins else None
     v = valid
     live = v & (stored_exp > now)
 
@@ -518,6 +554,17 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False,
 
     # ---- degenerate case: three-view closed form ---------------------------
     if not with_degen:
+        ins_row = None
+        if ins:
+            # Denied count of the whole segment, at its is_last lane:
+            # the first min(m_raw, size) ranks were allowed, the rest
+            # denied (the prefix closed form above).
+            seg_n = rank + 1
+            denied_seg = seg_n - jnp.minimum(m_raw, seg_n)
+            ins_row = (
+                stored_tat, stored_exp, stored_deny, denied_seg,
+                v & is_last,
+            )
         st_out = _finish(
             state, s, N, now, tol,
             allowed_main & v,
@@ -529,6 +576,7 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False,
             compact,
             s_add, s_sub,
             cur=cur_main,
+            ins_row=ins_row,
         )
         if count_expired:
             n_exp = jnp.sum(
@@ -598,11 +646,34 @@ def _gcra_body(state, batch, *, with_degen=True, compact=False,
 
     wrote = jnp.where(degen, wrote_degen, m_raw >= 1) & v & is_last
     tat_fin = jnp.where(degen, tat_fin_degen, tat_fin_main)
+    ins_row = None
+    if ins:
+        # Segment denied counts, at the is_last lane.  Main case: the
+        # prefix closed form (first min(m_raw, size) ranks allowed).
+        # Degenerate case: the three-view orbit — nothing after the
+        # first denial is allowed, so the allowed count is 0 / 1 /
+        # min(2, size) / size by which view first denies.
+        seg_n = rank + 1
+        allowed_cnt_main = jnp.minimum(m_raw, seg_n)
+        allowed_cnt_degen = jnp.where(
+            ~a0,
+            0,
+            jnp.where(
+                ~a1, 1, jnp.where(~a2, jnp.minimum(seg_n, 2), seg_n)
+            ),
+        )
+        denied_seg = seg_n - jnp.where(
+            degen, allowed_cnt_degen, allowed_cnt_main
+        )
+        ins_row = (
+            stored_tat, stored_exp, stored_deny, denied_seg, v & is_last
+        )
     st_out = _finish(
         state, s, N, now, tol,
         allowed_out, remaining_out, reset_out, retry_out,
         wrote, tat_fin, compact,
         sat_add, sat_sub,
+        ins_row=ins_row,
     )
     if count_expired:
         # allowed_out already carries & v.
@@ -618,11 +689,19 @@ _NS_PER_SEC = 1_000_000_000
 def _finish(
     state, s, N, now, tol, allowed, remaining, reset_after,
     retry_after, wrote, tat_fin, compact,
-    s_add, s_sub, cur=None,
+    s_add, s_sub, cur=None, ins_row=None,
 ):
     """Write back the surviving state (one packed-row scatter) and stack the
     outputs.  `add_nn`/`sub_nn` are the caller's saturating ops (the
     certified fast path passes the 2-op nonneg forms).
+
+    `ins_row` (insight-widened tables only) is (stored_tat, stored_exp,
+    stored_deny, denied_seg, touch): the scatter then covers every
+    decided segment's is_last lane — suppressed GCRA writes re-write
+    their row's stored tat/expiry verbatim (bit-identical state) while
+    the deny counter columns advance by the segment's denied count.
+    Same one-row-scatter cost; unique_indices still holds (one is_last
+    lane per slot).
 
     compact="cur" (certified path only — the degenerate views have no
     single `cur`) emits ONE i64 per request, `cur * 2 + allowed`, and
@@ -642,9 +721,23 @@ def _finish(
     # unique_indices promise honest.
     B = s.shape[0]
     scratch = N - B + jnp.arange(B, dtype=jnp.int32)
-    scatter_idx = jnp.where(wrote, s, scratch).astype(jnp.int32)
-    rows = pack_state(tat_fin, expiry_fin)
-    if _pallas_rows():
+    if ins_row is None:
+        scatter_idx = jnp.where(wrote, s, scratch).astype(jnp.int32)
+        rows = pack_state(tat_fin, expiry_fin)
+    else:
+        stored_tat, stored_exp, stored_deny, denied_seg, touch = ins_row
+        rows = jnp.concatenate(
+            [
+                pack_state(
+                    jnp.where(wrote, tat_fin, stored_tat),
+                    jnp.where(wrote, expiry_fin, stored_exp),
+                ),
+                _split_cols(stored_deny + denied_seg),
+            ],
+            axis=-1,
+        )
+        scatter_idx = jnp.where(touch, s, scratch).astype(jnp.int32)
+    if _pallas_rows() and ins_row is None:
         from . import pallas_ops
 
         state = pallas_ops.row_scatter(state, scatter_idx, rows)
@@ -1109,6 +1202,192 @@ def gcra_scan_packed_acc(
         step, (state, exp_acc), (packed, now.astype(jnp.int64))
     )
     return state, exp_acc, outs
+
+
+# ---- insight twins (L3.75 analytics) ------------------------------------ #
+# Same decisions (bit-for-bit) as the *_acc kernels plus the insight
+# accumulators riding the SAME launch: the per-slot denied-hit counter
+# lives inside the widened state rows (INS_WIDTH — maintained by the
+# decision path's own row gather/scatter, see _finish's ins_row), and
+# `ins_counts` (i64[2] running [allowed, denied] totals) folds in after
+# the scan from the launch's outputs — every output tier carries the
+# valid-masked allowed bit, so the totals cost two reductions.  Used
+# only when the BucketTable was built with insight enabled; with it off
+# the plain *_acc kernels run on 4-wide rows and the XLA graph is
+# untouched — the THROTTLECRAB_INSIGHT=0 kill switch is a different
+# jit entry point + table layout, not a traced branch.  Everything is
+# donated and device-resident; the host reads the accumulators only at
+# the insight tier's throttled poll (BucketTable.insight_counts /
+# insight_topk), so analytics add zero launches and zero fetches to the
+# decision path.
+
+
+def _lanes_allowed(out, compact):
+    """The valid-masked allowed bit of any output tier, [..., B]."""
+    if compact in ("cur", "w32"):
+        return (out & 1) != 0
+    return out[..., 0, :] != 0
+
+
+def _insight_totals(ins_counts, valid, out, compact):
+    """Advance the [allowed, denied] totals from one launch's outputs.
+    Allowed planes are already masked with `valid`, so `valid &
+    ~allowed` is exactly the decided-and-denied lanes; padding and
+    rejected lanes count nowhere."""
+    allowed = _lanes_allowed(out, compact)
+    denied = valid & ~allowed
+    return ins_counts + jnp.stack(
+        [
+            jnp.sum(allowed.astype(jnp.int64)),
+            jnp.sum(denied.astype(jnp.int64)),
+        ]
+    )
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0, 1, 2),
+    static_argnames=("with_degen", "compact"),
+)
+def gcra_batch_ins(
+    state, exp_acc, ins_counts, slots, rank, is_last, emission,
+    tolerance, quantity, valid, now, *, with_degen=True, compact=False,
+):
+    """gcra_batch_acc + insight accumulation; returns
+    (state, exp_acc, ins_counts, out).  `state` must be INS_WIDTH rows.
+    """
+    state, out, n_exp = _gcra_body(
+        state,
+        (
+            slots,
+            rank.astype(jnp.int64),
+            is_last,
+            emission,
+            tolerance,
+            quantity,
+            valid,
+            jnp.asarray(now, jnp.int64),
+        ),
+        with_degen=with_degen,
+        compact=compact,
+        count_expired=True,
+    )
+    ins_counts = _insight_totals(ins_counts, valid, out, compact)
+    return state, exp_acc + n_exp, ins_counts, out
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0, 1, 2),
+    static_argnames=("with_degen", "compact"),
+)
+def gcra_scan_ins(
+    state, exp_acc, ins_counts, slots, rank, is_last, emission,
+    tolerance, quantity, valid, now, *, with_degen=True, compact=False,
+):
+    """gcra_scan_acc + insight accumulation (INS_WIDTH rows)."""
+
+    def step(carry, batch):
+        st, acc = carry
+        st, out, n = _gcra_body(
+            st, batch, with_degen=with_degen, compact=compact,
+            count_expired=True,
+        )
+        return (st, acc + n), out
+
+    (state, exp_acc), outs = jax.lax.scan(
+        step,
+        (state, exp_acc),
+        (
+            slots,
+            rank.astype(jnp.int64),
+            is_last,
+            emission,
+            tolerance,
+            quantity,
+            valid,
+            now.astype(jnp.int64),
+        ),
+    )
+    ins_counts = _insight_totals(ins_counts, valid, outs, compact)
+    return state, exp_acc, ins_counts, outs
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0, 1, 2),
+    static_argnames=("with_degen", "compact"),
+)
+def gcra_scan_packed_ins(
+    state, exp_acc, ins_counts, packed, now, *,
+    with_degen=True, compact=False,
+):
+    """gcra_scan_packed_acc + insight accumulation (the valid flags
+    come straight off the packed request rows; INS_WIDTH rows)."""
+
+    def step(carry, kb):
+        st, acc = carry
+        p, now_k = kb
+        st, out, n = _gcra_body(
+            st, _unpack_requests(p, now_k),
+            with_degen=with_degen, compact=compact, count_expired=True,
+        )
+        return (st, acc + n), out
+
+    (state, exp_acc), outs = jax.lax.scan(
+        step, (state, exp_acc), (packed, now.astype(jnp.int64))
+    )
+    ins_counts = _insight_totals(
+        ins_counts,
+        (packed[..., 2] & PACK_FLAG_VALID) != 0,
+        outs,
+        compact,
+    )
+    return state, exp_acc, ins_counts, outs
+
+
+@partial(jax.jit, static_argnames=("capacity", "k"))
+def insight_topk(state, *, capacity, k):
+    """Device-side partial top-K of the denied-hit counter column of an
+    insight-widened table: (counts i64[k], slot ids i32[k]), highest
+    first.  One tiny launch per insight poll (~1/s), never on the
+    decision path; rows past `capacity` (the scratch tail) are
+    excluded."""
+    vals, idx = jax.lax.top_k(unpack_deny(state[:capacity]), k)
+    return vals, idx.astype(jnp.int32)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def insight_decay(state):
+    """Halve the denied-hit counter columns (the insight tier's
+    periodic decay: old heat fades, so the top-K tracks the CURRENT hot
+    set).  Floor division keeps counts exact against the host twin's
+    `// 2`; tat/expiry columns pass through untouched."""
+    return jnp.concatenate(
+        [state[..., :4], _split_cols(unpack_deny(state) // 2)], axis=-1
+    )
+
+
+@partial(jax.jit, donate_argnums=(1,), static_argnames=("capacity",))
+def sweep_expired_ins(now, state, capacity):
+    """sweep_expired for insight-widened rows: a vacated slot's
+    denied-hit count dies with it (the empty row zeroes ALL columns),
+    or the next key recycled into the slot would inherit the old key's
+    heat.  Returns (state, expired[:capacity])."""
+    now = jnp.asarray(now, jnp.int64)
+    _, expiry = unpack_state(state)
+    expired = expiry <= now
+    empty_rows = jnp.concatenate(
+        [
+            pack_state(
+                jnp.zeros_like(expiry), jnp.full_like(expiry, EMPTY_EXPIRY)
+            ),
+            jnp.zeros(state.shape[:-1] + (state.shape[-1] - 4,), jnp.int32),
+        ],
+        axis=-1,
+    )
+    state = jnp.where(expired[:, None], empty_rows, state)
+    return state, expired[:capacity]
 
 
 @partial(

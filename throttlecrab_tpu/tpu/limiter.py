@@ -461,12 +461,16 @@ class TpuRateLimiter(ScalarCompatMixin):
         keymap="python",
         device=None,
         auto_grow: bool = True,
+        insight: bool = False,
     ) -> None:
         """`keymap` selects the host key→slot backend: "python" (default,
         hashable keys of any kind), "native" (C++ batch resolver, bytes
         keys), "auto" (native when the toolchain built it), or a ready
-        keymap object exposing resolve/free_slots/grow/capacity."""
-        self.table = BucketTable(capacity, device=device)
+        keymap object exposing resolve/free_slots/grow/capacity.
+        `insight=True` arms the L3.75 analytics accumulators on the
+        table (see BucketTable.enable_insight); off, the decision path
+        is bit-identical to a limiter built without the subsystem."""
+        self.table = BucketTable(capacity, device=device, insight=insight)
         if keymap == "auto":
             keymap = "native" if _native_available() else "python"
         if keymap == "python":

@@ -452,6 +452,21 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
     rows = pack_state(
         jnp.asarray(tats, jnp.int64), jnp.asarray(expiries, jnp.int64)
     )
+    width = limiter.table.state.shape[-1]
+    if width > rows.shape[-1]:
+        # Insight-widened rows: restored/re-promoted keys start with a
+        # cold denied-hit counter (analytics are soft state; the host
+        # sketch keeps the history that matters).
+        rows = jnp.concatenate(
+            [
+                rows,
+                jnp.zeros(
+                    rows.shape[:-1] + (width - rows.shape[-1],),
+                    jnp.int32,
+                ),
+            ],
+            axis=-1,
+        )
     limiter.table.state = limiter.table.state.at[
         jnp.asarray(slots, jnp.int32)
     ].set(rows)

@@ -24,13 +24,17 @@ from .sat import I64_MAX
 from .kernel import (
     EMPTY_EXPIRY,
     gcra_batch_acc,
+    gcra_batch_ins,
     gcra_scan_acc,
     gcra_scan_byid_acc,
     gcra_scan_ids_acc,
+    gcra_scan_ins,
     gcra_scan_packed_acc,
+    gcra_scan_packed_ins,
     pack_id_rows,
     pack_state,
     sweep_expired,
+    sweep_expired_ins,
     unpack_state,
 )
 
@@ -156,10 +160,27 @@ class BucketTable(HwmMarksMixin):
 
     SCRATCH = 1 << 16  # max batch size; scratch rows for suppressed writes
 
-    def __init__(self, capacity: int, device=None) -> None:
+    def __init__(
+        self, capacity: int, device=None, insight: bool = False
+    ) -> None:
         self.capacity = capacity
         self.device = device
         self.state = self._alloc(capacity + self.SCRATCH)
+        # Insight tier (L3.75) accumulators: a per-slot denied-hit
+        # counter fused into the packed state rows (kernel.INS_WIDTH —
+        # maintained by the decision path's own row gather/scatter, so
+        # it is close to free) + running [allowed, denied] totals,
+        # updated inside every decision launch (the gcra_*_ins kernel
+        # twins) and read only at the insight tier's throttled poll.
+        # Rides ONLY the engine serving paths (check_batch / check_many
+        # / check_many_packed); the by-id bench paths bypass it.  Off
+        # by default: the plain *_acc kernels run on 4-wide rows and
+        # the decision path is bit-identical to a table built without
+        # insight.
+        self.insight = False
+        self.ins_counts = None
+        if insight:
+            self.enable_insight()
         # True while every stored TAT provably sits in [0, 2^62) — the
         # cross-launch precondition of the compact="cur" wire mode (see
         # track_cur_safety).  Fresh state is all-zero TATs: safe.
@@ -190,6 +211,78 @@ class BucketTable(HwmMarksMixin):
         device→host fetch — callers throttle (see
         TpuRateLimiter.take_expired_hits)."""
         return int(self.exp_acc)
+
+    # ---- insight tier (L3.75) accumulators ---------------------------- #
+
+    def enable_insight(self) -> None:
+        """Widen the state rows to kernel.INS_WIDTH (appending
+        zero-initialized denied-hit counter columns), allocate the
+        totals accumulator, and route decision launches through the
+        gcra_*_ins kernel twins.  Idempotent.  The Pallas row-movement
+        kernels only speak 4-wide rows, so an insight table always uses
+        the plain XLA gather/scatter regardless of THROTTLECRAB_PALLAS.
+        """
+        from .kernel import INS_WIDTH
+
+        if self.insight:
+            return
+        from . import pallas_ops
+
+        if pallas_ops.enabled():
+            # Loud, not silent: a THROTTLECRAB_PALLAS=1 deployment that
+            # also enables insight loses its opted-in DMA row path —
+            # the operator should pick one (THROTTLECRAB_INSIGHT=0
+            # restores it).
+            import logging
+
+            logging.getLogger("throttlecrab.table").warning(
+                "insight-widened rows disable the Pallas DMA row "
+                "kernels (THROTTLECRAB_PALLAS=1 requested); decision "
+                "launches use the plain XLA gather/scatter — set "
+                "THROTTLECRAB_INSIGHT=0 to keep the Pallas path"
+            )
+        ctx = (
+            jax.default_device(self.device)
+            if self.device is not None
+            else _nullcontext()
+        )
+        with ctx:
+            pad = jnp.zeros(
+                (self.state.shape[0], INS_WIDTH - 4), jnp.int32
+            )
+            self.state = jnp.concatenate([self.state, pad], axis=-1)
+            self.ins_counts = jnp.zeros((2,), jnp.int64)
+        self.insight = True
+
+    def insight_counts(self) -> tuple:
+        """(allowed_total, denied_total) decided through the insight
+        launch paths since construction.  One small device→host fetch
+        that synchronizes on in-flight launches — callers throttle
+        (the insight tier polls ~1/s)."""
+        if not self.insight:
+            return (0, 0)
+        counts = np.asarray(self.ins_counts)
+        return int(counts[0]), int(counts[1])
+
+    def insight_topk(self, k: int):
+        """Device-side partial top-K of the denied-hit counter column:
+        (counts, slot_ids) DEVICE arrays, highest count first — the
+        fetch is the caller's (np.asarray), so it can stay deferred.
+        One tiny extra launch per call; the insight tier invokes it
+        only at its poll cadence, never per decision."""
+        from .kernel import insight_topk
+
+        if not self.insight:
+            return None
+        k = max(1, min(int(k), self.capacity))
+        return insight_topk(self.state, capacity=self.capacity, k=k)
+
+    def insight_decay(self) -> None:
+        """Halve the denied-hit counter columns (periodic heat decay)."""
+        from .kernel import insight_decay
+
+        if self.insight:
+            self.state = insight_decay(self.state)
 
     def _alloc(self, rows: int) -> jax.Array:
         ctx = (
@@ -240,9 +333,7 @@ class BucketTable(HwmMarksMixin):
         track_cur_safety(self, compact, params_cur_safe)
         self.note_max_tolerance(_host_max_tol(valid, tolerance))
         self.note_launch_now(_host_max_now(now_ns))
-        self.state, self.exp_acc, out = gcra_batch_acc(
-            self.state,
-            self.exp_acc,
+        args = (
             jnp.asarray(slots, jnp.int32),
             jnp.asarray(rank, jnp.int32),
             jnp.asarray(is_last, bool),
@@ -251,9 +342,19 @@ class BucketTable(HwmMarksMixin):
             jnp.asarray(quantity, jnp.int64),
             jnp.asarray(valid, bool),
             now_ns,
-            with_degen=with_degen,
-            compact=compact,
         )
+        if self.insight:
+            self.state, self.exp_acc, self.ins_counts, out = (
+                gcra_batch_ins(
+                    self.state, self.exp_acc, self.ins_counts, *args,
+                    with_degen=with_degen, compact=compact,
+                )
+            )
+        else:
+            self.state, self.exp_acc, out = gcra_batch_acc(
+                self.state, self.exp_acc, *args,
+                with_degen=with_degen, compact=compact,
+            )
         return out
 
     def check_many(
@@ -276,9 +377,7 @@ class BucketTable(HwmMarksMixin):
         track_cur_safety(self, compact, params_cur_safe)
         self.note_max_tolerance(_host_max_tol(valid, tolerance))
         self.note_launch_now(_host_max_now(now_ns))
-        self.state, self.exp_acc, out = gcra_scan_acc(
-            self.state,
-            self.exp_acc,
+        args = (
             jnp.asarray(slots, jnp.int32),
             jnp.asarray(rank, jnp.int32),
             jnp.asarray(is_last, bool),
@@ -287,9 +386,19 @@ class BucketTable(HwmMarksMixin):
             jnp.asarray(quantity, jnp.int64),
             jnp.asarray(valid, bool),
             jnp.asarray(now_ns, jnp.int64),
-            with_degen=with_degen,
-            compact=compact,
         )
+        if self.insight:
+            self.state, self.exp_acc, self.ins_counts, out = (
+                gcra_scan_ins(
+                    self.state, self.exp_acc, self.ins_counts, *args,
+                    with_degen=with_degen, compact=compact,
+                )
+            )
+        else:
+            self.state, self.exp_acc, out = gcra_scan_acc(
+                self.state, self.exp_acc, *args,
+                with_degen=with_degen, compact=compact,
+            )
         return out
 
     def check_many_packed(
@@ -322,16 +431,24 @@ class BucketTable(HwmMarksMixin):
         # max (None saturates the mark — see note_max_tolerance).
         self.note_max_tolerance(max_tolerance)
         self.note_launch_now(_host_max_now(now_ns))
-        self.state, self.exp_acc, out = gcra_scan_packed_acc(
-            self.state,
-            self.exp_acc,
+        args = (
             packed
             if isinstance(packed, jax.Array)
             else jnp.asarray(packed, jnp.int32),
             jnp.asarray(now_ns, jnp.int64),
-            with_degen=with_degen,
-            compact=compact,
         )
+        if self.insight:
+            self.state, self.exp_acc, self.ins_counts, out = (
+                gcra_scan_packed_ins(
+                    self.state, self.exp_acc, self.ins_counts, *args,
+                    with_degen=with_degen, compact=compact,
+                )
+            )
+        else:
+            self.state, self.exp_acc, out = gcra_scan_packed_acc(
+                self.state, self.exp_acc, *args,
+                with_degen=with_degen, compact=compact,
+            )
         return out
 
     def upload_id_rows(
@@ -483,7 +600,16 @@ class BucketTable(HwmMarksMixin):
 
     def sweep(self, now_ns: int) -> np.ndarray:
         """Vacate expired slots; returns the boolean expired mask (host)."""
-        self.state, expired = sweep_expired(now_ns, self.state, self.capacity)
+        if self.insight:
+            # A vacated slot's denied-hit count dies with it: the slot
+            # is about to be recycled for a different key.
+            self.state, expired = sweep_expired_ins(
+                now_ns, self.state, self.capacity
+            )
+        else:
+            self.state, expired = sweep_expired(
+                now_ns, self.state, self.capacity
+            )
         return np.asarray(expired)
 
     def grow(self, new_capacity: int) -> None:
@@ -493,6 +619,20 @@ class BucketTable(HwmMarksMixin):
         extra = self._alloc(new_capacity - self.capacity)
         real = self.state[: self.capacity]
         scratch = self.state[self.capacity :]
+        if self.insight:
+            # New rows arrive 4-wide from _alloc; widen them to match
+            # the insight row layout (zero heat).
+            from .kernel import INS_WIDTH
+
+            extra = jnp.concatenate(
+                [
+                    extra,
+                    jnp.zeros(
+                        (extra.shape[0], INS_WIDTH - 4), jnp.int32
+                    ),
+                ],
+                axis=-1,
+            )
         self.state = jnp.concatenate([real, extra[: new_capacity - self.capacity], scratch])
         self.capacity = new_capacity
 
