@@ -182,7 +182,25 @@ def main() -> int:
                          "(same workload shape as the serving engine's "
                          "scan path), plus the measured overhead "
                          "fraction — budget <= 2%%")
+    ap.add_argument("--mesh", action="store_true",
+                    help="sharded-mesh A/B instead: the BASELINE "
+                         "config-5 multi-tenant shape on the widest "
+                         "available mesh (8 virtual CPU devices off-"
+                         "hardware), insight+tenants ON vs OFF, same "
+                         "session; benches/mesh_scaling.py owns the "
+                         "full D=1/2/4/8 sweep")
     args = ap.parse_args()
+
+    if args.mesh:
+        # The mesh A/B needs up to 8 devices; request virtual CPU
+        # devices before JAX initializes when the host has fewer
+        # (harmless on real multi-chip hardware: the flag only affects
+        # the host platform).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     if args.pallas:
         # Must precede the first kernel trace (read at trace time).
@@ -213,6 +231,8 @@ def main() -> int:
         return run_front_bench(args, device)
     if args.insight:
         return run_insight_bench(args, device)
+    if args.mesh:
+        return run_mesh_bench(args, device)
     pallas_interpreted = args.pallas and device.platform != "tpu"
     if pallas_interpreted:
         print(
@@ -541,6 +561,99 @@ def run_insight_bench(args, device) -> int:
                 "unit": "decisions/s",
                 "overhead_frac": round(1.0 - rate_on / rate_off, 4),
                 "poll_ms": round(poll_ms, 3),
+                "platform": device.platform,
+            }
+        )
+    )
+    return 0
+
+
+def run_mesh_bench(args, device) -> int:
+    """Sharded-mesh serving A/B (ISSUE 6): the BASELINE config-5
+    multi-tenant shape (64 tenants, tenant-prefixed keys, batch 4096)
+    on the widest available mesh, measured with the full mesh-native
+    stack ON (insight-widened shard rows + psum'd per-tenant counters)
+    vs the bare sharded limiter — the per-decision price of serving
+    analytics and tenant accounting from the mesh.  Same session, best
+    of 2 per mode (the repo bench idiom); benches/mesh_scaling.py owns
+    the D=1/2/4/8 width sweep."""
+    import jax
+
+    from throttlecrab_tpu.parallel.sharded import (
+        ShardedTpuRateLimiter,
+        make_mesh,
+    )
+    from throttlecrab_tpu.parallel.tenants import TenantRegistry
+
+    n_dev = min(8, len(jax.devices()))
+    tenants = 64
+    per_tenant = 400 if args.quick else 1562  # ~config-5: 64 x ~1.5k
+    batch = BATCH
+    depth = 4  # engine-shaped: K wire-mode windows per mesh launch
+    warm = 2
+    iters = 4 if args.quick else 12
+    keys = [
+        f"t{t}:k{i}" for t in range(tenants) for i in range(per_tenant)
+    ]
+    rng = np.random.default_rng(17)
+    sel = rng.integers(0, len(keys), ((warm + iters) * depth, batch))
+
+    def measure(tenants_on, insight):
+        lim = ShardedTpuRateLimiter(
+            capacity_per_shard=max(2 * len(keys) // n_dev, 4096),
+            mesh=make_mesh(n_dev),
+            keymap="auto",
+            auto_grow=False,
+            insight=insight,
+            tenants=(
+                TenantRegistry(max_tenants=tenants + 4)
+                if tenants_on
+                else None
+            ),
+        )
+        now = T0
+        t0 = None
+        for it in range(warm + iters):
+            if it == warm:
+                t0 = time.perf_counter()
+            windows = []
+            for j in range(depth):
+                now += 1_000_000_000
+                windows.append((
+                    [keys[i] for i in sel[it * depth + j]],
+                    5, 100, 60, 1, now,
+                ))
+            lim.rate_limit_many(windows, wire=True)
+        return iters * depth * batch / (time.perf_counter() - t0)
+
+    # Three points, best of 2 each: the bare sharded limiter (the
+    # pre-tenant baseline path), + the tenant layer (per-tenant psum'd
+    # counters + host tid attribution), + insight on top.  The insight
+    # A/B at FIXED tenant config is the acceptance number; the tenant
+    # delta is priced separately so neither hides in the other.
+    rate_bare = max(measure(False, False) for _ in range(2))
+    rate_tenants = max(measure(True, False) for _ in range(2))
+    rate_full = max(measure(True, True) for _ in range(2))
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "sharded-mesh multi-tenant decisions/s "
+                    f"(config-5 shape, {tenants} tenants x "
+                    f"{per_tenant} keys, batch={batch}, "
+                    f"{n_dev}-device mesh)"
+                ),
+                "mesh_bare": round(rate_bare),
+                "mesh_tenants": round(rate_tenants),
+                "mesh_full": round(rate_full),
+                "unit": "decisions/s",
+                "tenant_overhead_frac": round(
+                    1.0 - rate_tenants / rate_bare, 4
+                ),
+                "insight_overhead_frac": round(
+                    1.0 - rate_full / rate_tenants, 4
+                ),
+                "devices": n_dev,
                 "platform": device.platform,
             }
         )

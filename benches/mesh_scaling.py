@@ -1,0 +1,234 @@
+"""Mesh scaling proof: config-5 shape across D = 1/2/4/8 devices.
+
+ISSUE 6's acceptance bench: the BASELINE config-5 multi-tenant shape
+(64 tenants over ~100k keys, batch 4096, psum-reduced counters) run at
+every mesh width the backend offers, with the insight tier OFF and ON
+at each width — all in ONE session (the benchmarking convention:
+docs/benchmark-results.md; the 1-vCPU build host's delivered-CPU
+varies ±2× between sessions, so only same-session A/Bs mean anything).
+
+On real hardware the mesh widths are physical chips; elsewhere the
+sweep runs on 8 virtual CPU devices, which validates the collective
+layout and measures the end-to-end host+launch path, NOT ICI scaling —
+the virtual devices share one core, so decisions/s staying FLAT with D
+is the honest expectation there, while per-device work (capacity,
+keymap load) drops ~linearly with D.
+
+Also measured, same session: the vectorized host-side shard routing
+(one numpy CRC32 pass, parallel/tenants.py) against the per-key
+zlib.crc32 loop it replaced — the host-side satellite win.
+
+Usage:
+  python benches/mesh_scaling.py [--quick] [--keys-per-tenant N]
+                                 [--batch N] [--iters N]
+
+One JSON line per measurement, then a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+import zlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+NS = 1_000_000_000
+T0 = 1_753_000_000 * NS
+TENANTS = 64
+
+
+def out(line: dict) -> None:
+    print(json.dumps(line), flush=True)
+
+
+def bench_routing(keys, n_shards: int) -> dict:
+    """Vectorized CRC32 routing vs the per-key loop, same keys."""
+    from throttlecrab_tpu.parallel.tenants import crc32_rows, key_matrix
+
+    bkeys = [k.encode() for k in keys]
+
+    def loop():
+        return np.fromiter(
+            (zlib.crc32(k) % n_shards for k in bkeys), np.int32,
+            count=len(bkeys),
+        )
+
+    def vectorized():
+        mat, lens = key_matrix(bkeys)
+        return (crc32_rows(mat, lens) % np.uint32(n_shards)).astype(
+            np.int32
+        )
+
+    assert (loop() == vectorized()).all(), "routing twins diverged"
+    best = {}
+    for name, fn in (("loop", loop), ("vectorized", vectorized)):
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        best[name] = min(times)
+    n = len(bkeys)
+    return {
+        "metric": "host shard routing (per-key zlib loop vs one numpy "
+                  "CRC32 pass)",
+        "keys": n,
+        "loop_us_per_key": round(best["loop"] / n * 1e6, 4),
+        "vectorized_us_per_key": round(best["vectorized"] / n * 1e6, 4),
+        "speedup": round(best["loop"] / best["vectorized"], 2),
+    }
+
+
+def bench_mesh(D, n_dev_avail, keys, tenants_on, insight, batch, iters,
+               warm):
+    """Decisions/s for one (mesh width, insight) point."""
+    import jax
+
+    from throttlecrab_tpu.parallel.sharded import (
+        ShardedTpuRateLimiter,
+        make_mesh,
+    )
+    from throttlecrab_tpu.parallel.tenants import TenantRegistry
+
+    n_keys = len(keys)
+    depth = 4  # engine-shaped: K windows per mesh launch, wire mode
+    rng = np.random.default_rng(1000 + D)
+    sel = rng.integers(0, n_keys, ((warm + iters) * depth, batch))
+    lim = ShardedTpuRateLimiter(
+        capacity_per_shard=max(2 * n_keys // D, 4096),
+        mesh=make_mesh(min(D, n_dev_avail)),
+        keymap="auto",
+        auto_grow=False,
+        insight=insight,
+        tenants=(
+            TenantRegistry(max_tenants=TENANTS + 4) if tenants_on else None
+        ),
+    )
+    now = [T0]
+
+    def one_pass(n_launches):
+        """The serving shape: K-deep scan windows through
+        rate_limit_many in WIRE mode (the engine's backlog path and
+        compact output ladder), not bare non-wire single batches."""
+        t0 = time.perf_counter()
+        for it in range(n_launches):
+            windows = []
+            for j in range(depth):
+                now[0] += 1_000_000_000
+                windows.append((
+                    [keys[i] for i in sel[it * depth + j]],
+                    5, 100, 60, 1, now[0],
+                ))
+            lim.rate_limit_many(windows, wire=True)
+        return n_launches * depth * batch / (time.perf_counter() - t0)
+
+    one_pass(warm + iters)  # compile + intern every touched key
+
+    # Best of 2 timed passes on the warm limiter (the repo bench
+    # idiom: 1-vCPU container scheduling swings single runs wildly).
+    rate = max(one_pass(iters), one_pass(iters))
+    poll_ms = 0.0
+    if insight:
+        t1 = time.perf_counter()
+        lim.table.insight_counts()
+        tk = lim.table.insight_topk(64)
+        np.asarray(tk[0]), np.asarray(tk[1])
+        poll_ms = (time.perf_counter() - t1) * 1e3
+    return {
+        "devices": D,
+        "insight": insight,
+        "decisions_per_sec": round(rate),
+        "poll_ms": round(poll_ms, 3),
+        "psum_allowed": lim.total_allowed,
+        "psum_denied": lim.total_denied,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--keys-per-tenant", type=int, default=0,
+                    help="keys per tenant (default: config-5 shape, "
+                    "~100k keys total; --quick quarters it)")
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--iters", type=int, default=0,
+                    help="timed batches per point (default 32; "
+                    "--quick 8)")
+    args = ap.parse_args()
+
+    # The sweep needs up to 8 devices; request virtual CPU devices
+    # before JAX initializes when the host has fewer.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    import throttlecrab_tpu  # noqa: F401  (enables x64)
+
+    n_dev = len(jax.devices())
+    per_tenant = args.keys_per_tenant or (400 if args.quick else 1562)
+    iters = args.iters or (8 if args.quick else 32)
+    warm = 2 if args.quick else 4
+    keys = [
+        f"t{t}:k{i}" for t in range(TENANTS) for i in range(per_tenant)
+    ]
+    out({
+        "metric": "mesh_scaling setup",
+        "tenants": TENANTS,
+        "keys": len(keys),
+        "batch": args.batch,
+        "iters": iters,
+        "devices_available": n_dev,
+    })
+
+    # Satellite: host-side routing win, same session.
+    rng = np.random.default_rng(7)
+    route_keys = [keys[i] for i in rng.integers(0, len(keys), 8 * 4096)]
+    out(bench_routing(route_keys, max(n_dev, 2)))
+
+    results = []
+    for D in (1, 2, 4, 8):
+        if D > n_dev:
+            out({"metric": "mesh point skipped", "devices": D,
+                 "reason": f"backend exposes {n_dev}"})
+            continue
+        for insight in (False, True):
+            r = bench_mesh(
+                D, n_dev, keys, tenants_on=True, insight=insight,
+                batch=args.batch, iters=iters, warm=warm,
+            )
+            results.append(r)
+            out(r)
+
+    # Summary: per-width insight overhead + scaling vs D=1.
+    by = {(r["devices"], r["insight"]): r["decisions_per_sec"]
+          for r in results}
+    summary = {"metric": "mesh_scaling summary (config-5 shape, "
+                         "same-session A/B)"}
+    base = by.get((1, False))
+    for D in (1, 2, 4, 8):
+        off, on = by.get((D, False)), by.get((D, True))
+        if off is None or on is None:
+            continue
+        summary[f"d{D}_off"] = off
+        summary[f"d{D}_on"] = on
+        summary[f"d{D}_insight_overhead_frac"] = round(1 - on / off, 4)
+        if base:
+            summary[f"d{D}_vs_d1"] = round(off / base, 3)
+    out(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

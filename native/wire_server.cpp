@@ -1102,6 +1102,12 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                     // tell "back off" from "server bug").
                     code = 503;
                     body = "{\"error\": \"server overloaded\"}";
+                } else if (status[i] == 5) {
+                    // Tenant slot quota: a capacity condition like
+                    // overload, not a server bug — same 503 class.
+                    code = 503;
+                    body = "{\"error\": \"tenant capacity quota "
+                           "exceeded\"}";
                 } else {
                     code = 500;  // engine-level error (http.rs:148-157)
                     body = status[i] == 1
@@ -1141,6 +1147,8 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                 payload = "-ERR invalid rate limit parameters\r\n";
             } else if (status[i] == 4) {
                 payload = "-ERR server overloaded\r\n";
+            } else if (status[i] == 5) {
+                payload = "-ERR tenant capacity quota exceeded\r\n";
             } else {
                 payload = "-ERR internal error\r\n";
             }

@@ -160,8 +160,14 @@ def run_seed(seed, steps, sharded_mesh):
     if sharded_mesh is not None:
         from throttlecrab_tpu.parallel.sharded import ShardedTpuRateLimiter
 
+        # Alternating seeds run the mesh with the insight tier armed
+        # (INS_WIDTH shard rows + psum'd totals riding every launch):
+        # the differential below then pins sharded+insight decisions to
+        # the scalar oracle across the whole tier ladder, and the even
+        # seeds keep pinning the 4-wide kill-switch layout.
         shl = ShardedTpuRateLimiter(
-            capacity_per_shard=256, mesh=sharded_mesh
+            capacity_per_shard=256, mesh=sharded_mesh,
+            insight=bool(seed % 2),
         )
     else:
         shl = None

@@ -108,6 +108,41 @@ class PerfResult:
     last_recovery_s: float = -1.0
     # GET /stats polling results (--stats; a StatsProbe or None).
     stats_probe: object = field(default=None, repr=False)
+    # Per-tenant [allowed, denied, errors] splits, keyed by the tenant
+    # prefix before the first ":" — populated for tenant-prefixed key
+    # patterns (noisy-neighbor), so tenant isolation is a measured,
+    # replayable scenario rather than a one-off test.
+    tenant_counts: dict = field(default_factory=dict, repr=False)
+
+    def track_tenant(self, key: str, allowed) -> None:
+        tenant = key.split(":", 1)[0] if ":" in key else "(default)"
+        row = self.tenant_counts.get(tenant)
+        if row is None:
+            row = self.tenant_counts[tenant] = [0, 0, 0]
+        if allowed is None:
+            row[2] += 1
+        elif allowed:
+            row[0] += 1
+        else:
+            row[1] += 1
+
+    def tenant_summary(self) -> dict:
+        """{tenant: {allowed, denied, errors, deny_rate}}, worst deny
+        rate first — the noisy neighbor should top this list while the
+        compliant tenants' deny rates stay near zero."""
+        out = {}
+        for tenant, (a, d, e) in sorted(
+            self.tenant_counts.items(),
+            key=lambda kv: -(kv[1][1] / max(sum(kv[1]), 1)),
+        ):
+            total = a + d + e
+            out[tenant] = {
+                "allowed": a,
+                "denied": d,
+                "errors": e,
+                "deny_rate": round(d / total, 4) if total else 0.0,
+            }
+        return out
 
     def track_outcome(self, is_error: bool, t_s: float) -> None:
         """Feed per-request outcomes (in completion order) for the
@@ -440,14 +475,19 @@ async def run_perf_test(
     ]
     barrier = _make_barrier(workers)
     result = PerfResult(transport, 0, 0.0, 0, 0, 0)
+    # Tenant-prefixed patterns report per-tenant splits (the isolation
+    # scenario the sharded mesh's namespace layer serves).
+    track_tenants = key_pattern == "noisy-neighbor"
 
-    def tally(allowed) -> None:
+    def tally(allowed, key=None) -> None:
         if allowed is None:
             result.errors += 1
         elif allowed:
             result.allowed += 1
         else:
             result.denied += 1
+        if track_tenants and key is not None:
+            result.track_tenant(key, allowed)
         if chaos:
             result.track_outcome(
                 allowed is None, time.perf_counter() - t_start
@@ -489,8 +529,8 @@ async def run_perf_test(
                         return
                     continue
                 result.latencies_s.append(time.perf_counter() - t0)
-                for allowed in outcomes:
-                    tally(allowed)
+                for key, allowed in zip(window, outcomes):
+                    tally(allowed, key)
             return
         for done, (key, delay) in enumerate(zip(keys, wl.delays())):
             if probe is not None and done == shift and probe.shift_t < 0:
@@ -513,7 +553,7 @@ async def run_perf_test(
                     return
                 continue
             result.latencies_s.append(time.perf_counter() - t0)
-            tally(allowed)
+            tally(allowed, key)
 
     t_start = time.perf_counter()
     await asyncio.gather(*(worker(w) for w in range(workers)))
@@ -546,7 +586,7 @@ def main(argv=None) -> int:
     p.add_argument("--key-pattern", default="random",
                    choices=["sequential", "random", "zipfian",
                             "user-resource", "hotkey-abuse",
-                            "flash-crowd", "chaos"])
+                            "flash-crowd", "chaos", "noisy-neighbor"])
     p.add_argument("--stats", action="store_true",
                    help="poll GET /stats (the insight tier) every "
                         "200 ms during the run and report hot-key "
@@ -627,6 +667,11 @@ def main(argv=None) -> int:
             summary["chaos"] = result.chaos_summary()
         if result.stats_probe is not None:
             summary["stats"] = result.stats_probe.summary()
+        if result.tenant_counts:
+            # Top 8 tenants by deny rate: the noisy neighbor leads,
+            # compliant tenants' rates should sit near zero.
+            per_tenant = result.tenant_summary()
+            summary["tenants"] = dict(list(per_tenant.items())[:8])
         print(json.dumps(summary))
     return 0
 

@@ -104,6 +104,32 @@ def make_keys(
         hot = np.where(pos < shift, hot_a, hot_b)
         is_hot = rng.random(n_requests) < 0.9
         ids = np.where(is_hot, hot, cold)
+    elif pattern == "noisy-neighbor":
+        # Multi-tenant isolation scenario (the sharded mesh's namespace
+        # layer is built for it): 64 tenants share the server, tenant
+        # t0 is abusive — ~50% of the whole stream hammers a handful of
+        # its keys far past their limit AND sprays ever-fresh keys
+        # (slot-capacity pressure, the tenant-quota surface) — while 63
+        # compliant tenants spread modest traffic over their own key
+        # ranges.  Keys carry the tenant prefix (`t<N>:key:<i>`), so
+        # per-tenant /stats, psum'd tenant counters, quotas, and
+        # tenant-affine routing all see it; the load generator reports
+        # per-tenant allow/deny splits for it (PerfResult.tenant_counts).
+        tenants = 64
+        per_tenant = max(key_space // tenants, 1)
+        n_hot = max(per_tenant // 100, 1)
+        hot = rng.integers(0, n_hot, n_requests)  # tenant 0's hot keys
+        # Fresh-key spray from the abusive tenant: monotone ids past its
+        # range (seed-offset so every worker/run brings new ones).
+        spray = per_tenant + (seed + 1) * n_requests + np.arange(n_requests)
+        t_other = rng.integers(1, tenants, n_requests)
+        k_other = rng.integers(0, per_tenant, n_requests)
+        u = rng.random(n_requests)
+        tid = np.where(u < 0.5, 0, t_other)
+        kid = np.where(
+            u < 0.4, hot, np.where(u < 0.5, spray, k_other)
+        )
+        return [f"t{t}:key:{k}" for t, k in zip(tid, kid)]
     elif pattern == "chaos":
         # The chaos-run companion (harness --chaos) for a server armed
         # with THROTTLECRAB_FAULTS: half hot-key abuse (exercises the

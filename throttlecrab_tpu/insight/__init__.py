@@ -41,7 +41,12 @@ import logging
 import threading
 from typing import Optional
 
-from .collector import NS_PER_SEC, RateWindow, SlotKeyResolver
+from .collector import (
+    NS_PER_SEC,
+    RateWindow,
+    ShardedSlotKeyResolver,
+    SlotKeyResolver,
+)
 from .sketch import SpaceSavingSketch
 
 __all__ = ["InsightTier", "SpaceSavingSketch"]
@@ -116,8 +121,12 @@ class InsightTier:
         # state buffers (observed as spurious RPC failures).
         self.poll_lock = None
         # Per-slot last-seen denied counts (delta extraction between
-        # polls; halved alongside the device column on decay).
+        # polls; halved alongside the device column on decay).  Keyed
+        # by the resolver's slot-id encoding: when that re-bases
+        # (sharded table growth), the map resets rather than diffing
+        # new ids against stale entries.
         self._slot_last: dict = {}
+        self._slot_id_base = None
         # Device totals (last fetched) + host-oracle counters: the sum
         # is the truthful all-paths total across degrade/recover.
         self._dev_allowed = 0
@@ -148,16 +157,31 @@ class InsightTier:
     def attach(self, limiter) -> None:
         """Bind the DEVICE limiter (supervision wrappers are unwrapped:
         polls read the device table and keymap directly; the wrapper's
-        degraded state only matters to the host-path counters)."""
+        degraded state only matters to the host-path counters).  Both
+        the single-device and the mesh-sharded limiter qualify — the
+        sharded table answers the same poll surface (insight_counts /
+        insight_topk / insight_decay) with mesh-global results, and its
+        GLOBAL slot ids resolve through the per-shard keymaps."""
         dev = getattr(limiter, "inner", limiter)
         table = getattr(dev, "table", None)
         if table is None or not getattr(table, "insight", False):
             raise ValueError(
-                "insight tier needs a single-device limiter whose "
-                "table was built with insight enabled"
+                "insight tier needs a device limiter whose table was "
+                "built with insight enabled"
             )
         self.limiter = dev
-        self._resolver = SlotKeyResolver(dev.keymap)
+        if hasattr(dev, "keymaps"):
+            self._resolver = ShardedSlotKeyResolver(dev)
+        else:
+            self._resolver = SlotKeyResolver(dev.keymap)
+        # Pin the slot-id encoding base NOW so the first poll records
+        # normally; only a LATER re-base (sharded growth) triggers the
+        # baseline-only poll.
+        id_base_fn = getattr(self._resolver, "id_base", None)
+        self._slot_id_base = (
+            id_base_fn() if id_base_fn is not None else None
+        )
+        self._slot_last = {}
 
     # ------------------------------------------------------------------ #
 
@@ -239,6 +263,20 @@ class InsightTier:
             return True
         hot_keys = []
         with self._lock:
+            # Growth re-based the global slot ids (sharded mesh): a
+            # stale delta map would re-record hot slots' whole
+            # cumulative counts under their new ids.  Re-baseline this
+            # poll WITHOUT recording — its inter-poll deltas are
+            # unknowable per slot, so dropping them once (sketch
+            # under-counts slightly) beats re-counting whole histories
+            # (totals, rates and /stats counters are unaffected either
+            # way: they come from the psum'd totals, not the sketch).
+            id_base_fn = getattr(self._resolver, "id_base", None)
+            id_base = id_base_fn() if id_base_fn is not None else None
+            rebased = id_base != self._slot_id_base
+            if rebased:
+                self._slot_id_base = id_base
+                self._slot_last = {}
             # Concentration denominator is the ENGINE-decided denial
             # delta (device + host oracle), deliberately excluding
             # cache-served denials: it measures how concentrated the
@@ -256,6 +294,10 @@ class InsightTier:
             top_delta = 0
             for slot, val, key in zip(ids, vals, keys):
                 if val <= 0:
+                    continue
+                if rebased:
+                    # Baseline-only pass after an id re-base.
+                    new_last[slot] = val
                     continue
                 prev = slot_last.get(slot, 0)
                 # A count below last-seen means the slot was swept (or
@@ -385,6 +427,15 @@ class InsightTier:
                     "prewarmed_total": self.prewarmed_total,
                 },
             }
+        # Per-tenant dimensions (the sharded limiter's namespace layer,
+        # parallel/tenants.py): mesh-global psum-reduced counters, so
+        # /stats answers per-tenant truthfully with zero host-side
+        # per-request accounting.
+        tenant_stats = getattr(self.limiter, "tenant_stats", None)
+        if tenant_stats is not None:
+            tenants = tenant_stats()
+            if tenants:
+                out["tenants"] = tenants
         if state is not None:
             out["engine_state"] = state
         return out

@@ -182,6 +182,10 @@ async def run_server(config: Config) -> None:
                           seed=config.faults_seed))
         log.warning("fault injection armed: %s", config.faults)
     device_limiter = create_limiter(config)
+    if getattr(device_limiter, "tenants", None) is not None:
+        # Sharded mesh with the tenant layer armed: export the
+        # psum-reduced per-tenant counters on GET /metrics.
+        metrics.set_tenant_stats_provider(device_limiter.tenant_stats)
     # Failure-domain supervision (L3.75): every transport drives the
     # same supervised limiter, so retry/degrade/re-promote decisions
     # are made once, under the shared limiter lock.

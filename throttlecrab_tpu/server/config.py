@@ -70,6 +70,24 @@ _SPEC = [
      "Host key->slot backend: auto, python, native"),
     ("shards", "THROTTLECRAB_SHARDS", 1, int,
      "Number of devices to shard the bucket table over"),
+    # --- tenant/namespace layer (sharded mesh only, parallel/tenants.py)
+    ("tenant_max", "THROTTLECRAB_TENANT_MAX", 64, int,
+     "Max distinct tenants/namespaces tracked by the sharded mesh's "
+     "per-tenant counters and quotas (key prefix before the first "
+     "delimiter; extras share an overflow bucket; 0 disables the "
+     "tenant layer entirely; needs --shards > 1)"),
+    ("tenant_delim", "THROTTLECRAB_TENANT_DELIM", ":", str,
+     "Single-byte delimiter separating the tenant/namespace prefix "
+     "from the rest of the key"),
+    ("tenant_quota", "THROTTLECRAB_TENANT_QUOTA", 0.0, float,
+     "Per-tenant slot-capacity quota as a fraction of each shard's "
+     "capacity (0 disables): new keys past the quota are refused with "
+     "the tenant-quota status so one abusive tenant cannot fill the "
+     "table and evict others' slots"),
+    ("tenant_affinity", "THROTTLECRAB_TENANT_AFFINITY", False, bool,
+     "Route keys by their tenant/namespace hash instead of the full "
+     "key, making each tenant's keys shard-local (keys without a "
+     "delimiter still spread by full-key hash)"),
     ("profile_dir", "THROTTLECRAB_PROFILE_DIR", "", str,
      "Directory for an xprof trace of the first launches (empty: off)"),
     # --- front tier (L3.5: exact deny cache + admission control) -------
@@ -192,6 +210,10 @@ class Config:
     max_scan_depth: int = 16
     keymap: str = "auto"
     shards: int = 1
+    tenant_max: int = 64
+    tenant_delim: str = ":"
+    tenant_quota: float = 0.0
+    tenant_affinity: bool = False
     profile_dir: str = ""
     front_deny_cache: int = 65536
     front_max_pending: int = 100_000
@@ -270,6 +292,35 @@ class Config:
             )
         if self.shards < 1:
             raise ConfigError("shards must be >= 1")
+        if self.tenant_max < 0:
+            raise ConfigError("tenant_max must be >= 0")
+        if self.tenant_max == 1:
+            raise ConfigError(
+                "tenant_max must be 0 (off) or >= 2 (id 0 is the "
+                "overflow bucket)"
+            )
+        if len(self.tenant_delim.encode()) != 1:
+            raise ConfigError("tenant_delim must be exactly one byte")
+        if not 0.0 <= self.tenant_quota <= 1.0:
+            raise ConfigError("tenant_quota must be in [0, 1]")
+        if self.tenant_quota > 0 and self.tenant_max == 0:
+            raise ConfigError(
+                "tenant_quota needs the tenant layer (tenant_max > 0)"
+            )
+        if self.tenant_affinity and self.tenant_max == 0:
+            raise ConfigError(
+                "tenant_affinity needs the tenant layer (tenant_max > 0)"
+            )
+        if self.shards == 1 and (
+            self.tenant_affinity or self.tenant_quota > 0
+        ):
+            # Explicitly-requested tenant isolation knobs only exist on
+            # the sharded mesh — refusing beats silently dropping them
+            # (tenant_max alone keeps its default and stays quiet).
+            raise ConfigError(
+                "tenant_affinity/tenant_quota need a sharded mesh "
+                "(--shards > 1)"
+            )
         if self.front_deny_cache < 0:
             raise ConfigError("front_deny_cache must be >= 0")
         if self.front_max_pending < 0 or self.front_max_wait_us < 0:

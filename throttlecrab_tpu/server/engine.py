@@ -42,6 +42,7 @@ from ..tpu.limiter import (
     STATUS_INVALID_PARAMS,
     STATUS_NEGATIVE_QUANTITY,
     STATUS_OK,
+    STATUS_TENANT_QUOTA,
 )
 from .types import ThrottleRequest, ThrottleResponse
 
@@ -51,6 +52,7 @@ STATUS_MESSAGES = {
     STATUS_NEGATIVE_QUANTITY: "quantity cannot be negative",
     STATUS_INVALID_PARAMS: "invalid rate limit parameters",
     STATUS_INTERNAL: "internal error",
+    STATUS_TENANT_QUOTA: "tenant capacity quota exceeded",
 }
 
 
@@ -495,7 +497,15 @@ class BatchingEngine:
             if fut.done():
                 continue
             status = int(result.status[i])
-            if status != STATUS_OK:
+            if status == STATUS_TENANT_QUOTA:
+                # A capacity condition, not a server bug: surface it as
+                # the protocol overload status (HTTP 503 / gRPC
+                # RESOURCE_EXHAUSTED / RESP -ERR) so clients can tell
+                # "tenant over quota, back off" from a 500-class fault.
+                fut.set_exception(
+                    OverloadError(STATUS_MESSAGES[STATUS_TENANT_QUOTA])
+                )
+            elif status != STATUS_OK:
                 fut.set_exception(
                     ThrottleError(
                         STATUS_MESSAGES.get(status, "internal error")

@@ -60,6 +60,11 @@ METRIC_NAMES = (
     "throttlecrab_tpu_insight_tracked_keys",
     "throttlecrab_tpu_insight_prewarmed_total",
     "throttlecrab_tpu_insight_polls",
+    # Tenant/namespace layer (sharded mesh, parallel/tenants.py):
+    # mesh-global psum-reduced per-tenant counters.
+    "throttlecrab_tpu_tenant_allowed",
+    "throttlecrab_tpu_tenant_denied",
+    "throttlecrab_tpu_tenant_quota_rejections",
 )
 
 
@@ -143,6 +148,8 @@ class Metrics:
         self._engine_state = None
         # Insight tier (L3.75).
         self._insight_stats = None
+        # Tenant/namespace layer (sharded mesh).
+        self._tenant_stats = None
 
     @classmethod
     def builder(cls) -> "MetricsBuilder":
@@ -278,6 +285,12 @@ class Metrics:
         """`provider()` -> {peer_addr: {"forwarded": n, "failed": n}};
         exported as per-peer counters (cluster deployments only)."""
         self._cluster_stats = provider
+
+    def set_tenant_stats_provider(self, provider) -> None:
+        """`provider()` -> ShardedTpuRateLimiter.tenant_stats(); exported
+        as per-tenant allowed/denied/quota-rejection counters (sharded
+        deployments with the tenant layer armed)."""
+        self._tenant_stats = provider
 
     # ------------------------------------------------------------------ #
 
@@ -486,6 +499,29 @@ class Metrics:
             "counter",
             ins.get("polls", 0),
         )
+        # Tenant/namespace layer (sharded mesh deployments only).
+        tenant_provider = getattr(self, "_tenant_stats", None)
+        if tenant_provider is not None:
+            stats = tenant_provider()
+            for name, field, help_ in (
+                ("throttlecrab_tpu_tenant_allowed", "allowed",
+                 "Allowed decisions per tenant (mesh-global, "
+                 "psum-reduced in-launch)"),
+                ("throttlecrab_tpu_tenant_denied", "denied",
+                 "Denied decisions per tenant (mesh-global, "
+                 "psum-reduced in-launch)"),
+                ("throttlecrab_tpu_tenant_quota_rejections",
+                 "quota_rejections",
+                 "New keys refused by the per-tenant slot-capacity "
+                 "quota"),
+            ):
+                out.append(f"# HELP {name} {help_}")
+                out.append(f"# TYPE {name} counter")
+                for tenant, counts in sorted(stats.items()):
+                    escaped = escape_label_value(tenant)
+                    out.append(
+                        f'{name}{{tenant="{escaped}"}} {counts[field]}'
+                    )
         provider = getattr(self, "_cluster_stats", None)
         if provider is not None:
             stats = provider()

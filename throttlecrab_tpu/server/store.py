@@ -8,22 +8,39 @@ between the single-device and mesh-sharded limiter.
 
 from __future__ import annotations
 
+import logging
+
 from ..tpu.cleanup import CleanupPolicy, make_policy
 from ..tpu.limiter import TpuRateLimiter
+
+log = logging.getLogger("throttlecrab.store")
 
 
 def create_limiter(config):
     """Build the device limiter the engine will drive."""
     if config.shards > 1:
         from ..parallel.sharded import ShardedTpuRateLimiter, make_mesh
+        from ..parallel.tenants import TenantRegistry
 
         mesh = make_mesh(config.shards)
+        tenants = None
+        if getattr(config, "tenant_max", 0) > 0:
+            tenants = TenantRegistry(
+                max_tenants=config.tenant_max,
+                delim=config.tenant_delim,
+                quota_frac=config.tenant_quota,
+                affinity=config.tenant_affinity,
+            )
         return ShardedTpuRateLimiter(
             capacity_per_shard=max(
                 config.store_capacity // config.shards, 1024
             ),
             mesh=mesh,
             keymap=config.keymap,
+            # Insight tier (L3.75) is mesh-native: widened shard rows,
+            # psum'd totals, one-launch mesh-global top-K.
+            insight=getattr(config, "insight", False),
+            tenants=tenants,
         )
     return TpuRateLimiter(
         capacity=config.store_capacity,
@@ -81,6 +98,33 @@ def create_front_tier(config, metrics, limiter):
     except (AttributeError, TypeError, ValueError):
         params = {}
     certifiable = "collect_cur" in params or "wire" not in params
+    if config.front_deny_cache > 0 and not certifiable:
+        # Loud when the operator actually CHOSE a cache size, informative
+        # when it is just the default riding a sharded/cluster config (a
+        # WARNING about a choice never made would train operators to
+        # ignore the line that matters when the cache was configured).
+        import dataclasses
+
+        from .config import Config
+
+        default = next(
+            f.default
+            for f in dataclasses.fields(Config)
+            if f.name == "front_deny_cache"
+        )
+        emit = (
+            log.info
+            if config.front_deny_cache == default
+            else log.warning
+        )
+        emit(
+            "front-tier deny cache configured "
+            "(THROTTLECRAB_FRONT_DENY_CACHE=%d) but this limiter "
+            "cannot certify entries (no exact observed-TAT surface); "
+            "building admission control only — set "
+            "THROTTLECRAB_FRONT_DENY_CACHE=0 to silence",
+            config.front_deny_cache,
+        )
     deny = (
         DenyCache(config.front_deny_cache)
         if config.front_deny_cache > 0 and certifiable
@@ -108,8 +152,10 @@ def create_insight(config, metrics, limiter, front):
     """Build the insight tier (L3.75: device-resident traffic
     analytics + the deny-cache/admission feedback loop) from the
     THROTTLECRAB_INSIGHT_* knobs, or None when disabled or the limiter
-    cannot carry it (sharded/cluster tables have no single insight
-    column today — the kill-switch path, exact pre-insight behavior).
+    cannot carry it.  Both the single-device and the mesh-sharded
+    limiter carry it (the sharded table serves mesh-global results);
+    a limiter without an insight-armed table — e.g. a duck-typed
+    replacement — drops the tier LOUDLY, never silently.
     """
     if not config.insight:
         return None
@@ -118,6 +164,18 @@ def create_insight(config, metrics, limiter, front):
     dev = getattr(limiter, "inner", limiter)
     table = getattr(dev, "table", None)
     if table is None or not getattr(table, "insight", False):
+        # Loud, not silent (mirrors the Pallas-downgrade warning): the
+        # operator asked for insight but this limiter cannot carry the
+        # widened analytics rows, so /stats, the deny-cache prewarm and
+        # the admission feedback loop are all dropped for this boot.
+        log.warning(
+            "insight tier requested (THROTTLECRAB_INSIGHT=1) but the "
+            "%s limiter's table does not carry the insight "
+            "accumulators; serving WITHOUT /stats analytics or the "
+            "admission/deny-cache feedback loop — set "
+            "THROTTLECRAB_INSIGHT=0 to silence",
+            type(dev).__name__,
+        )
         return None
     insight = InsightTier(
         limiter=dev,

@@ -48,6 +48,11 @@ STATUS_OK = 0
 STATUS_NEGATIVE_QUANTITY = 1
 STATUS_INVALID_PARAMS = 2
 STATUS_INTERNAL = 3
+# 4 is the front tier's STATUS_OVERLOADED (front/admission.py).
+# A NEW key refused by its tenant's slot-capacity quota (the sharded
+# limiter's namespace layer, parallel/tenants.py); the tenant's
+# existing keys keep deciding normally.
+STATUS_TENANT_QUOTA = 5
 
 
 def segment_info(slots, mask):

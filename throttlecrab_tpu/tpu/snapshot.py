@@ -330,6 +330,35 @@ def load_snapshot(
     return restored
 
 
+def _reattribute_tenants(limiter) -> None:
+    """Rebuild a sharded limiter's per-tenant slot-quota bookkeeping
+    after a bulk restore (no-op when the quota is unarmed): restored
+    slots were allocated behind the prepare path's back, and an
+    unattributed live slot would otherwise be mistaken for a fresh
+    allocation — and could be quota-refused and freed, losing its
+    restored state — on its first post-restore touch."""
+    tos_list = getattr(limiter, "_tenant_of_slot", None)
+    if tos_list is None:
+        return
+    reg = limiter.tenants
+    for d, km in enumerate(limiter.keymaps):
+        tos = tos_list[d]
+        used = limiter._tenant_used[d]
+        tos[:] = -1
+        used[:] = 0
+        for key, slot in km.items():
+            kb = (
+                key
+                if isinstance(key, bytes)
+                else str(key).encode("utf-8", "surrogateescape")
+            )
+            p = kb.find(reg.delim_byte)
+            tid = reg.tid_of(kb[:p] if p > 0 else b"")
+            if 0 <= slot < len(tos):
+                tos[slot] = tid
+                used[tid] += 1
+
+
 def _bulk_insert(limiter, keys, tats, expiries) -> int:
     """Allocate slots for `keys` and write their state rows directly;
     returns the number actually inserted.
@@ -385,8 +414,6 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
     if hasattr(limiter, "keymaps"):  # ShardedTpuRateLimiter
         import jax
 
-        from ..parallel.sharded import shard_of_key
-
         D = limiter.n_shards
         by_shard: list = [[] for _ in range(D)]
         skipped = 0
@@ -404,9 +431,12 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
                     # the whole snapshot.
                     skipped += 1
                     continue
-            by_shard[shard_of_key(kb, D)].append(i)
+            # The LIMITER's routing, not the bare hash: tenant-affine
+            # deployments route by namespace prefix, and a restored key
+            # must land on the shard the serving path will probe.
+            by_shard[limiter.shard_of(kb)].append(i)
         # np.array (not asarray): jax arrays surface as read-only views.
-        state = np.array(limiter.table.state)  # [D, rows, 4]
+        state = np.array(limiter.table.state)  # [D, rows, W]
         for d, ix in enumerate(by_shard):
             if not ix:
                 continue
@@ -430,10 +460,24 @@ def _bulk_insert(limiter, keys, tats, expiries) -> int:
                     jnp.asarray([expiries[i] for i in ix], jnp.int64),
                 )
             )
+            if state.shape[-1] > rows.shape[-1]:
+                # Insight-widened shard rows: restored keys start with
+                # zero heat, like the single-device restore path.
+                rows = np.concatenate(
+                    [
+                        rows,
+                        np.zeros(
+                            (len(ix), state.shape[-1] - rows.shape[-1]),
+                            np.int32,
+                        ),
+                    ],
+                    axis=-1,
+                )
             state[d, slots] = rows
         limiter.table.state = jax.device_put(
             state, limiter.table.sharding
         )
+        _reattribute_tenants(limiter)
         return len(keys) - skipped
 
     if getattr(limiter.keymap, "BYTES_KEYS", False):
