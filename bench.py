@@ -182,6 +182,13 @@ def main() -> int:
                          "(same workload shape as the serving engine's "
                          "scan path), plus the measured overhead "
                          "fraction — budget <= 2%%")
+    ap.add_argument("--cluster", action="store_true",
+                    help="elastic-cluster A/B instead: the 2-node "
+                         "mixed workload under legacy modulo routing "
+                         "vs the consistent-hash ring (must be within "
+                         "session noise) vs ring+replication, same "
+                         "session; benches/cluster_throughput.py owns "
+                         "the full join/kill/rejoin timeline")
     ap.add_argument("--mesh", action="store_true",
                     help="sharded-mesh A/B instead: the BASELINE "
                          "config-5 multi-tenant shape on the widest "
@@ -233,6 +240,8 @@ def main() -> int:
         return run_insight_bench(args, device)
     if args.mesh:
         return run_mesh_bench(args, device)
+    if args.cluster:
+        return run_cluster_bench(args)
     pallas_interpreted = args.pallas and device.platform != "tpu"
     if pallas_interpreted:
         print(
@@ -566,6 +575,25 @@ def run_insight_bench(args, device) -> int:
         )
     )
     return 0
+
+
+def run_cluster_bench(args) -> int:
+    """Elastic-cluster A/B: delegate to benches/cluster_throughput.py's
+    2-node legacy-vs-ring scenarios (a subprocess keeps this process
+    free of node event-loop threads).  The ring must be within session
+    noise of the legacy modulo path — the lookup is one vectorized
+    searchsorted either way."""
+    import subprocess
+
+    cmd = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "benches", "cluster_throughput.py"),
+        "--ab-only",
+    ]
+    if args.quick:
+        cmd.append("--quick")
+    return subprocess.call(cmd)
 
 
 def run_mesh_bench(args, device) -> int:

@@ -1,157 +1,255 @@
-"""Two-node cluster decision throughput (DCN path, real sockets).
+"""Elastic-cluster decision throughput: ring-vs-legacy A/B and the
+join/kill/rejoin timeline (per-node AND aggregate numbers, the
+enterprise multi-machine reporting shape of arXiv:2603.29113).
 
-Spawns one peer server process (cluster RPC + HTTP health), builds an
-in-process ClusterLimiter as node 0 against it, and drives Zipf-skewed
-batches through rate_limit_many — the same batch API the serving engine
-uses — reporting decisions/s for:
+Topology: N in-process nodes, each a real `ClusterLimiter` +
+`ClusterServer` RPC listener on its own event-loop thread — the DCN
+forwarding path runs over real TCP sockets; only process isolation is
+elided (all nodes share this host's vCPU anyway, so spawned processes
+would measure the same contention with extra startup noise).
 
-  - local-only traffic (keys owned by node 0: cluster overhead is one
-    ownership partition, no RPC), and
-  - the natural 2-node mix (~half the keys forward to the peer over TCP
-    per batch, pipelined by the owner-routing layer).
+Scenarios:
 
-The gap between the two is the price of the DCN hop on this host (both
-processes share one vCPU here, so the mix number is a conservative
-floor — on real separate hosts the peer decides in parallel).
+- ``--ab`` (default on): 2-node A/B — the same Zipf-skewed mixed
+  workload through (a) legacy crc32-modulo routing (``vnodes=0``, the
+  kill switch), (b) the consistent-hash ring, and (c) ring + warm
+  replication.  (a) vs (b) isolates the ring lookup cost (must be
+  within session noise); (c) adds the replica pump.
+- ``--elastic`` (default on): the 3-node lifecycle timeline — per-node
+  and aggregate decisions/s measured in each phase: 2-node steady,
+  node-2 join (first windows after OP_JOIN, migration riding along),
+  3-node steady, node-2 kill (breaker + replica takeover riding
+  along), and rejoin.
 
-Prints one JSON line per scenario.  --quick shrinks the workload.
+Prints one JSON line per measurement.  --quick shrinks the workload.
+Numbers are only comparable within one session (1-vCPU host, see
+docs/benchmark-results.md).
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import os
-import subprocess
+import socket
 import sys
+import threading
 import time
-import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-CLUSTER_A = 19381
-CLUSTER_B = 19382
-HTTP_B = 19383
-NODES = f"127.0.0.1:{CLUSTER_A},127.0.0.1:{CLUSTER_B}"
+NS = 1_000_000_000
+T0 = 1_761_000_000 * NS
 
 
-def spawn_peer():
-    env = dict(os.environ)
-    env["THROTTLECRAB_PLATFORM"] = "cpu"
-    env["THROTTLECRAB_CLUSTER_TIMEOUT_MS"] = "60000"
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    return subprocess.Popen(
-        [
-            sys.executable, "-m", "throttlecrab_tpu.server",
-            "--http", "--http-port", str(HTTP_B),
-            "--cluster-nodes", NODES, "--cluster-index", "1",
-            "--store", "adaptive", "--log-level", "warn",
-        ],
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-    )
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
 
 
-def wait_healthy(proc, port, deadline_s=120):
-    t0 = time.time()
-    while time.time() - t0 < deadline_s:
-        if proc.poll() is not None:
-            out = proc.stdout.read()
-            raise RuntimeError(f"peer exited rc={proc.returncode}: {out}")
+class BenchNode:
+    """In-process cluster node with a live RPC listener."""
+
+    def __init__(self, index, nodes, capacity, **kw):
+        from throttlecrab_tpu.parallel.cluster import (
+            ClusterLimiter,
+            ClusterServer,
+        )
+        from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+        kw.setdefault("io_timeout_s", 60.0)
+        self.index = index
+        self.limiter = TpuRateLimiter(capacity=capacity, keymap="auto")
+        self.limiter.rate_limit_batch(["__warm__"], 5, 100, 60, 1, T0 - NS)
+        self.cl = ClusterLimiter(self.limiter, nodes, index, **kw)
+        self.loop = asyncio.new_event_loop()
+        self.srv = ClusterServer(
+            "127.0.0.1", int(nodes[index].rpartition(":")[2]),
+            self.cl.local, self.cl.device_lock, cluster=self.cl,
+        )
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        asyncio.run_coroutine_threadsafe(
+            self.srv.start(), self.loop
+        ).result(timeout=10)
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def kill(self):
+        if getattr(self, "_dead", False):
+            return
+        self._dead = True
+        asyncio.run_coroutine_threadsafe(
+            self.srv.stop(), self.loop
+        ).result(timeout=10)
+        self.cl.close()
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)
+
+
+def zipf_batches(rng, universe, batch, depth, base_now, step):
+    ranks = np.arange(1, len(universe) + 1, dtype=np.float64)
+    p = ranks ** -1.1
+    p /= p.sum()
+    batches = []
+    for j in range(depth):
+        draw = rng.choice(len(universe), batch, p=p)
+        batches.append(
+            ([universe[i] for i in draw], 10, 1000, 60, 1,
+             base_now + (step * depth + j) * 1_000_000)
+        )
+    return batches
+
+
+def drive(node, rng, universe, batch, depth, windows, base_now,
+          warm=1):
+    """Windows through one frontend; returns (decisions/s, decisions)."""
+    decided = 0
+    t_start = time.perf_counter() if warm == 0 else None
+    for w in range(windows + warm):
+        res = node.cl.rate_limit_many(
+            zipf_batches(rng, universe, batch, depth, base_now, w),
+            wire=True,
+        )
+        assert len(res) == depth
+        if warm and w == warm - 1:
+            t_start = time.perf_counter()
+        if w >= warm:
+            decided += depth * batch
+    dt = time.perf_counter() - t_start
+    return decided / dt, decided
+
+
+def emit(**row):
+    print(json.dumps(row), flush=True)
+
+
+def run_ab(args):
+    """2-node mixed-workload A/B: legacy modulo vs ring vs ring+replica."""
+    rng = np.random.default_rng(11)
+    n_keys = 20_000 if args.quick else 60_000
+    universe = [b"ab:%d" % i for i in range(n_keys)]
+    windows = 3 if args.quick else 8
+    for mode, kw in (
+        ("legacy_modulo", dict(vnodes=0, replicate=False)),
+        ("ring", dict(vnodes=128, replicate=False)),
+        ("ring_replicate", dict(vnodes=128, replicate=True)),
+    ):
+        ports = free_ports(2)
+        nodes = [f"127.0.0.1:{p}" for p in ports]
+        cap = max(n_keys * 2, 1 << 16)
+        a = BenchNode(0, nodes, cap, **kw)
+        b = BenchNode(1, nodes, cap, **kw)
         try:
-            with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/health", timeout=1
-            ) as r:
-                if r.status == 200:
-                    return
-        except Exception:
-            time.sleep(0.3)
-    raise TimeoutError("peer did not become healthy")
+            if kw["vnodes"]:
+                a.cl.announce_join_all()
+                b.cl.announce_join_all()
+            rate, decided = drive(
+                a, rng, universe, args.batch, args.depth, windows, T0
+            )
+            emit(scenario=f"ab_2node_mix_{mode}",
+                 decisions_per_sec=round(rate), batch=args.batch,
+                 depth=args.depth, windows=windows)
+        finally:
+            a.kill()
+            b.kill()
+
+
+def run_elastic(args):
+    """3-node lifecycle timeline with per-node + aggregate numbers."""
+    rng = np.random.default_rng(13)
+    n_keys = 20_000 if args.quick else 60_000
+    universe = [b"el:%d" % i for i in range(n_keys)]
+    windows = 2 if args.quick else 5
+    ports = free_ports(3)
+    node_addrs = [f"127.0.0.1:{p}" for p in ports]
+    cap = max(n_keys * 2, 1 << 16)
+    kw = dict(vnodes=128, replicate=True)
+    live = {}
+    now = [T0]
+
+    def phase(name, indices):
+        total_rate = 0.0
+        for i in indices:
+            rate, _ = drive(
+                live[i], rng, universe, args.batch, args.depth, windows,
+                now[0],
+            )
+            now[0] += windows * args.depth * 1_000_000 + NS
+            emit(scenario=f"elastic_{name}", node=i,
+                 decisions_per_sec=round(rate))
+            total_rate += rate
+        emit(scenario=f"elastic_{name}", node="aggregate",
+             decisions_per_sec=round(total_rate), live_nodes=len(indices))
+
+    try:
+        live[0] = BenchNode(0, node_addrs, cap, **kw)
+        live[1] = BenchNode(1, node_addrs, cap, **kw)
+        live[0].cl.announce_join_all()
+        live[1].cl.announce_join_all()
+        phase("steady_2node", (0, 1))
+
+        # JOIN: node 2 enters; the first windows ride the migration.
+        t_join = time.perf_counter()
+        live[2] = BenchNode(2, node_addrs, cap, **kw)
+        live[2].cl.announce_join_all()
+        phase("join", (0, 1, 2))
+        emit(scenario="elastic_join_meta",
+             join_wall_s=round(time.perf_counter() - t_join, 3),
+             migrated_in=live[2].cl.migrated_in)
+        phase("steady_3node", (0, 1, 2))
+
+        # KILL: node 2 dies; survivors absorb (breaker + takeover ride
+        # the first windows).
+        live[2].kill()
+        phase("kill", (0, 1))
+        emit(scenario="elastic_kill_meta",
+             takeovers=[live[i].cl.takeover_count for i in (0, 1)],
+             replica_rows=[len(live[i].cl.replica_store) for i in (0, 1)])
+
+        # REJOIN: node 2 returns and drains its (empty) table.
+        live[2] = BenchNode(2, node_addrs, cap, **kw)
+        live[2].cl.announce_join_all()
+        phase("rejoin", (0, 1, 2))
+    finally:
+        for n in live.values():
+            try:
+                n.kill()
+            except Exception:
+                pass
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--batch", type=int, default=4096)
-    ap.add_argument("--depth", type=int, default=8,
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--depth", type=int, default=4,
                     help="batches per rate_limit_many window")
+    ap.add_argument("--ab-only", action="store_true")
+    ap.add_argument("--elastic-only", action="store_true")
     args = ap.parse_args()
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
-    from throttlecrab_tpu.parallel.cluster import ClusterLimiter, node_of_key
-    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
-
-    peer = spawn_peer()
-    try:
-        wait_healthy(peer, HTTP_B)
-
-        local = TpuRateLimiter(capacity=1 << 18, keymap="auto")
-        cl = ClusterLimiter(local, NODES.split(","), 0, io_timeout_s=60.0)
-
-        n_keys = 20_000 if args.quick else 100_000
-        keys_all = [b"ck:%d" % i for i in range(n_keys)]
-        local_keys = [k for k in keys_all if node_of_key(k, 2) == 0]
-
-        rng = np.random.default_rng(7)
-        now0 = 1_753_000_000_000_000_000
-
-        def run(name, universe, windows):
-            # Zipf-skewed draws from the given key universe.
-            ranks = np.arange(1, len(universe) + 1, dtype=np.float64)
-            p = ranks ** -1.1
-            p /= p.sum()
-            # warm + timed
-            decided = 0
-            t_start = None
-            for w in range(windows + 2):
-                batches = []
-                for j in range(args.depth):
-                    draw = rng.choice(len(universe), args.batch, p=p)
-                    bkeys = [universe[i] for i in draw]
-                    batches.append(
-                        (bkeys, 10, 1000, 60, 1,
-                         now0 + (w * args.depth + j) * 1_000_000)
-                    )
-                res = cl.rate_limit_many(batches, wire=True)
-                assert len(res) == args.depth
-                if w == 1:
-                    t_start = time.perf_counter()
-                elif w > 1:
-                    decided += args.depth * args.batch
-            dt = time.perf_counter() - t_start
-            print(json.dumps({
-                "scenario": name,
-                "decisions_per_sec": round(decided / dt),
-                "batch": args.batch,
-                "depth": args.depth,
-                "windows": windows,
-            }), flush=True)
-
-        windows = 4 if args.quick else 12
-        run("cluster_local_only", local_keys, windows)
-        run("cluster_2node_mix", keys_all, windows)
-        stats = cl.peer_stats()[NODES.split(",")[1]]
-        print(json.dumps({
-            "scenario": "peer_stats",
-            "forwarded": int(stats["forwarded"]),
-            "failed": int(stats["failed"]),
-        }), flush=True)
-        return 0
-    finally:
-        peer.terminate()
-        try:
-            peer.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            peer.kill()
-            peer.wait()
+    if not args.elastic_only:
+        run_ab(args)
+    if not args.ab_only:
+        run_elastic(args)
+    return 0
 
 
 if __name__ == "__main__":

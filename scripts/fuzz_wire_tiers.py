@@ -351,6 +351,92 @@ def run_hotkey_deny_seed(seed, steps):
     return asyncio.run(run())
 
 
+def run_cluster_frame_fuzz(seed, iters):
+    """Malformed-frame hardening for the elastic-cluster codecs
+    (OP_MIGRATE/OP_REPLICA rows, OP_RING weights, OP_ROUTE_BATCH):
+    random truncations, byte flips and splices of valid frames must
+    either decode cleanly or raise the typed ClusterProtocolError —
+    never OverflowError/MemoryError/IndexError/struct.error, and never
+    size an allocation from an attacker-controlled count.  Returns the
+    number of frames exercised."""
+    from throttlecrab_tpu.parallel.cluster import (
+        OP_MIGRATE,
+        OP_REPLICA,
+        OP_RING,
+        ClusterProtocolError,
+        decode_batch,
+        decode_ring,
+        decode_route,
+        decode_rows,
+        encode_batch,
+        encode_ring,
+        encode_route,
+        encode_rows,
+    )
+
+    rng = np.random.default_rng(seed)
+    decoders = {
+        "rows": decode_rows,
+        "ring": decode_ring,
+        "route": decode_route,
+        "batch": decode_batch,
+    }
+    done = 0
+    for _ in range(iters):
+        n = int(rng.integers(0, 12))
+        keys = [
+            bytes(rng.integers(0, 256, int(rng.integers(0, 40)),
+                               dtype=np.uint8))
+            for _ in range(n)
+        ]
+        kind = ("rows", "ring", "route", "batch")[int(rng.integers(4))]
+        if kind == "rows":
+            op = OP_MIGRATE if rng.random() < 0.5 else OP_REPLICA
+            frame = encode_rows(
+                op, int(rng.integers(0, 8)), int(rng.integers(0, 2**32)),
+                keys,
+                rng.integers(-(2**62), 2**62, n),
+                rng.integers(-(2**62), 2**62, n),
+            )
+        elif kind == "ring":
+            frame = encode_ring(
+                OP_RING, int(rng.integers(0, 2**32)),
+                rng.random(int(rng.integers(0, 8))).tolist(),
+            )
+        else:
+            params = [
+                tuple(int(x) for x in rng.integers(-(2**40), 2**40, 4))
+                for _ in keys
+            ]
+            now = int(rng.integers(0, 2**62))
+            frame = (
+                encode_route(keys, params, now, int(rng.integers(0, 8)))
+                if kind == "route"
+                else encode_batch(keys, params, now)
+            )
+        body = bytearray(frame[5:])  # strip _HDR, like the server does
+        mode = rng.random()
+        if mode < 0.35 and len(body):          # truncate
+            body = body[: int(rng.integers(0, len(body)))]
+        elif mode < 0.7 and len(body):         # flip bytes
+            for _ in range(int(rng.integers(1, 4))):
+                body[int(rng.integers(len(body)))] = int(
+                    rng.integers(256)
+                )
+        elif mode < 0.85:                      # append garbage
+            body += bytes(
+                rng.integers(0, 256, int(rng.integers(1, 16)),
+                             dtype=np.uint8)
+            )
+        try:
+            decoders[kind](bytes(body))
+        except ClusterProtocolError:
+            pass  # the typed rejection the wire contract promises
+        done += 1
+        TOTAL["requests"] += 1
+    return done
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seeds", type=int, default=24)
@@ -381,6 +467,14 @@ def main() -> int:
         hits = run_hotkey_deny_seed(4000 + s, args.steps * 2)
         print(
             f"hotkey seed {4000 + s} ok — {hits} deny-cache hits",
+            file=sys.stderr, flush=True,
+        )
+    # Elastic-cluster wire hardening: mutated migrate/replica/ring/
+    # route frames must fail typed, never crash.
+    for s in range(args.seeds):
+        n = run_cluster_frame_fuzz(5000 + s, args.steps * 40)
+        print(
+            f"cluster-frame seed {5000 + s} ok — {n} frames",
             file=sys.stderr, flush=True,
         )
     print(

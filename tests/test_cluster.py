@@ -86,6 +86,86 @@ def test_malformed_frames_rejected():
     assert _HDR.size == 5
 
 
+def test_migrate_replica_frames_hardened():
+    """OP_MIGRATE/OP_REPLICA frames carry the same malformed-frame
+    contract as OP_THROTTLE_BATCH: attacker-controlled counts cannot
+    size allocations, truncation raises the typed error, trailing
+    garbage is rejected."""
+    import struct
+
+    from throttlecrab_tpu.parallel.cluster import (
+        OP_MIGRATE,
+        ClusterProtocolError,
+        _ROWS_HEAD,
+        decode_ring,
+        decode_route,
+        decode_rows,
+        encode_ring,
+        encode_rows,
+    )
+
+    # Round trip.
+    f = encode_rows(OP_MIGRATE, 1, 9, [b"k1", b""], [10, -5], [20, 1 << 61])
+    origin, epoch, keys, tats, exps = decode_rows(f[5:])
+    assert (origin, epoch, keys) == (1, 9, [b"k1", b""])
+    assert tats.tolist() == [10, -5] and exps.tolist() == [20, 1 << 61]
+    # Oversized count in a tiny frame.
+    with pytest.raises(ClusterProtocolError):
+        decode_rows(_ROWS_HEAD.pack(0, 0, 0xFFFFFFFF))
+    # Truncated item.
+    bad = _ROWS_HEAD.pack(0, 0, 1) + struct.pack("<H", 500) + b"k"
+    with pytest.raises(ClusterProtocolError):
+        decode_rows(bad)
+    # Trailing garbage after a valid frame.
+    with pytest.raises(ClusterProtocolError):
+        decode_rows(f[5:] + b"\x00")
+    # Short/mismatched ring frames.
+    with pytest.raises(ClusterProtocolError):
+        decode_ring(b"\x01")
+    with pytest.raises(ClusterProtocolError):
+        decode_ring(encode_ring(5, 3, [1.0, 1.0])[5:] + b"\x00\x00")
+    # Route frame: too short for even the hop byte.
+    with pytest.raises(ClusterProtocolError):
+        decode_route(b"")
+
+
+def test_ring_vectorized_matches_oracle_and_excludes():
+    from throttlecrab_tpu.parallel.ring import HashRing, batch_crc32
+
+    nodes = [f"10.0.0.{i}:9000" for i in range(5)]
+    ring = HashRing(nodes, 128)
+    keys = [b"rk:%d" % i for i in range(3000)]
+    owners = ring.owners_of(batch_crc32(keys))
+    # Vectorized lookup is bit-identical to the per-key oracle.
+    for i in (0, 1, 7, 100, 999, 2999):
+        assert ring.owner_of(keys[i]) == owners[i]
+    # Roughly balanced (5 nodes x 128 vnodes).
+    counts = np.bincount(owners, minlength=5)
+    assert counts.min() > 300, counts
+    # Excluding a node moves ONLY its keys, each to its successor.
+    o2 = ring.owners_of(batch_crc32(keys), exclude=frozenset({2}))
+    moved = owners != o2
+    assert (owners[moved] == 2).all() and (o2 != 2).all()
+    # successor_of agrees with exclusion routing.
+    for i in np.flatnonzero(moved)[:50]:
+        assert ring.successor_of(keys[int(i)], 2) == o2[int(i)]
+    # Weights scale ownership monotonically; weight 0 owns nothing.
+    half = HashRing(nodes, 128, weights={0: 0.5}).owners_of(
+        batch_crc32(keys)
+    )
+    zero = HashRing(nodes, 128, weights={0: 0.0}).owners_of(
+        batch_crc32(keys)
+    )
+    full0 = int((owners == 0).sum())
+    assert int((half == 0).sum()) < full0
+    assert int((zero == 0).sum()) == 0
+    # A membership change moves ~1/N of the space, not ~all of it (the
+    # modulo failure mode the ring exists to fix).
+    o4 = HashRing(nodes[:4], 128).owners_of(batch_crc32(keys))
+    stayed = o4 == owners
+    assert stayed.mean() > 0.70, stayed.mean()
+
+
 def test_oversized_key_fails_only_itself():
     local = TpuRateLimiter(capacity=64)
     cl = ClusterLimiter(local, ["127.0.0.1:1"], 0)
@@ -196,6 +276,17 @@ def two_nodes():
     try:
         wait_healthy(a, HTTP_A)
         wait_healthy(b, HTTP_B)
+        # Warm every decide path (first-touch jit compiles take 10-40 s
+        # on this host): local decides on each node AND the cross-node
+        # forward in both directions.  Without this, a starved host can
+        # push the first forwarded decide past the 60 s deadline and
+        # ring failover masks it as a fresh local decision — an
+        # over-allow the real assertions below would misattribute.
+        warm_a = key_owned_by(0, "warm0")
+        warm_b = key_owned_by(1, "warm1")
+        for port in (HTTP_A, HTTP_B):
+            for k in (warm_a, warm_b):
+                throttle_via(port, k, burst=100)
         yield a, b
     finally:
         for p in (a, b):
@@ -208,10 +299,20 @@ def two_nodes():
                 p.kill()
 
 
+#: Servers spawned with the default config route on the consistent-hash
+#: ring (THROTTLECRAB_CLUSTER_VNODES=128), so ownership probes must use
+#: the same ring the servers build from the same node list.
+def _default_ring(n_nodes: int = 2):
+    from throttlecrab_tpu.parallel.ring import HashRing
+
+    return HashRing(NODES.split(",")[:n_nodes], 128)
+
+
 def key_owned_by(node_idx: int, prefix: str) -> str:
+    ring = _default_ring()
     for i in range(10_000):
         k = f"{prefix}:{i}"
-        if node_of_key(k.encode(), 2) == node_idx:
+        if ring.owner_of(k.encode()) == node_idx:
             return k
     raise AssertionError("no key found")
 
@@ -274,20 +375,55 @@ def test_bidirectional_concurrent_traffic_no_deadlock(two_nodes):
     assert elapsed < 20, f"bidirectional traffic took {elapsed:.1f}s"
 
 
-def test_peer_failure_isolated(two_nodes):
-    """Killing node B fails only B-owned keys on A; A-owned keys keep
-    deciding (a reference instance going down loses only its key range)."""
+def test_peer_failure_successor_takes_over(two_nodes):
+    """Killing node B no longer costs its key range: the ring routes
+    B-owned keys to their successor (A, in a 2-node ring), which
+    absorbs the warm replica and keeps deciding — zero client-visible
+    failures, the elastic upgrade over the legacy modulo tier's
+    STATUS_INTERNAL (that behavior is pinned separately in-process with
+    vnodes=0)."""
     a, b = two_nodes
     key_b = key_owned_by(1, "failproc")
     key_a = key_owned_by(0, "okproc")
     b.terminate()
     b.wait(timeout=30)
-    # B-owned key via A → 500 (internal error), not a hang.
-    with pytest.raises(urllib.error.HTTPError) as exc:
-        throttle_via(HTTP_A, key_b)
-    assert exc.value.code == 500
-    # A-owned key still fine.
+    # B-owned key via A: decided by A as B's ring successor (no 500).
+    results = [throttle_via(HTTP_A, key_b)["allowed"] for _ in range(5)]
+    assert results == [True, True, True, False, False]
+    # A-owned key unaffected.
     assert throttle_via(HTTP_A, key_a)["allowed"] is True
+    # The takeover is observable on the cluster view.
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{HTTP_A}/health/cluster", timeout=10
+    ) as r:
+        view = json.loads(r.read())
+    assert view["mode"] == "ring"
+    assert view["takeovers"] >= 1
+    assert f"127.0.0.1:{RPC_B}" in view["absorbed"]
+
+
+def test_legacy_modulo_dead_peer_fails_only_its_range():
+    """vnodes=0 (the kill switch) keeps the pre-ring contract: a dead
+    peer's keys fail with STATUS_INTERNAL, everything else decides."""
+    from throttlecrab_tpu.tpu.limiter import STATUS_INTERNAL
+
+    local = TpuRateLimiter(capacity=256)
+    cl = ClusterLimiter(
+        local, ["127.0.0.1:1", "127.0.0.1:2"], 1,
+        io_timeout_s=0.2, connect_timeout_s=0.2,
+    )
+    assert cl.ring is None and cl._pump is None
+    key_remote = next(
+        f"lm:{i}" for i in range(10_000)
+        if node_of_key(f"lm:{i}".encode(), 2) == 0
+    )
+    key_local = next(
+        f"ll:{i}" for i in range(10_000)
+        if node_of_key(f"ll:{i}".encode(), 2) == 1
+    )
+    res = cl.rate_limit_batch([key_remote, key_local], 5, 100, 60, 1, T0)
+    assert res.allowed.tolist() == [False, True]
+    assert res.status[0] == STATUS_INTERNAL and res.status[1] == 0
 
 
 def test_unencodable_key_fails_only_itself():

@@ -119,6 +119,47 @@ def test_prometheus_export_names():
     assert 'throttlecrab_top_denied_keys{key="bad-key",rank="1"} 1' in text
 
 
+def test_cluster_metrics_export():
+    """Elastic-cluster surfaces: per-peer breaker/migration counters
+    and the epoch/replica/takeover gauges, exported exactly when the
+    providers are wired (cluster deployments only)."""
+    m = Metrics()
+    base = m.export_prometheus()
+    assert "throttlecrab_cluster_epoch" not in base
+    m.set_cluster_stats_provider(lambda: {
+        "10.0.0.1:9" : {"forwarded": 7, "failed": 2, "breaker_open": 1,
+                        "migrated_keys": 40},
+    })
+    m.set_cluster_view_provider(lambda: {
+        "epoch": 3, "migrated_in": 12, "replica_rows": 5, "takeovers": 1,
+    })
+    text = m.export_prometheus()
+    assert 'throttlecrab_cluster_forwarded_total{peer="10.0.0.1:9"} 7' in text
+    assert 'throttlecrab_cluster_breaker_open{peer="10.0.0.1:9"} 1' in text
+    assert 'throttlecrab_cluster_migrated_keys{peer="10.0.0.1:9"} 40' in text
+    assert "throttlecrab_cluster_epoch 3" in text
+    assert "throttlecrab_cluster_migrated_in_total 12" in text
+    assert "throttlecrab_cluster_replica_rows 5" in text
+    assert "throttlecrab_cluster_takeovers_total 1" in text
+
+
+def test_cluster_config_knobs_validate():
+    from throttlecrab_tpu.server.config import Config, ConfigError
+
+    cfg = Config(http=True)
+    assert cfg.cluster_vnodes == 128 and cfg.cluster_replicate is True
+    cfg.validate()
+    cfg.cluster_vnodes = 0  # legacy kill switch is a valid setting
+    cfg.validate()
+    cfg.cluster_vnodes = -1
+    with pytest.raises(ConfigError):
+        cfg.validate()
+    cfg.cluster_vnodes = 128
+    cfg.cluster_handoff_timeout_ms = 0
+    with pytest.raises(ConfigError):
+        cfg.validate()
+
+
 def test_top_denied_keys_ranking_and_caps():
     """denied_keys_test.rs: ranking by count, prune at 3x, key-length cap."""
     t = TopDeniedKeys(max_keys=3)

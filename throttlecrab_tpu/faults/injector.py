@@ -11,6 +11,8 @@ points threaded through the real failure surfaces —
   * ``peer``     — a cluster peer socket operation fails,
   * ``keymap``   — host key→slot resolution hits capacity exhaustion,
   * ``snapshot`` — snapshot file I/O fails,
+  * ``migrate``  — a cluster key-range migration (send or apply side)
+    fails mid-handoff — the elastic ring's hardest window,
 
 each raising the same exception *shape* the real system produces at that
 surface (an ``UNAVAILABLE``-prefixed runtime error for the device
@@ -37,7 +39,7 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
-SITES = ("launch", "fetch", "peer", "keymap", "snapshot")
+SITES = ("launch", "fetch", "peer", "keymap", "snapshot", "migrate")
 MODES = ("transient", "persistent", "count", "hang")
 
 
@@ -57,8 +59,10 @@ def _site_error(site: str, detail: str) -> Exception:
         return InjectedDeviceError(
             f"UNAVAILABLE: injected {site} fault ({detail})"
         )
-    if site == "peer":
-        return ConnectionError(f"injected peer socket fault ({detail})")
+    if site in ("peer", "migrate"):
+        return ConnectionError(
+            f"injected {site} socket fault ({detail})"
+        )
     if site == "keymap":
         from ..core.errors import InternalError
 

@@ -8,6 +8,12 @@ requests with the same batched kernel — one `shard_map`-ped launch for the
 whole mesh, with `psum`-reduced allowed/denied counters riding the ICI.
 """
 
+from .ring import HashRing
 from .sharded import ShardedBucketTable, ShardedTpuRateLimiter, shard_of_key
 
-__all__ = ["ShardedBucketTable", "ShardedTpuRateLimiter", "shard_of_key"]
+__all__ = [
+    "HashRing",
+    "ShardedBucketTable",
+    "ShardedTpuRateLimiter",
+    "shard_of_key",
+]

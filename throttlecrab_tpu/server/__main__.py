@@ -210,8 +210,25 @@ async def run_server(config: Config) -> None:
             breaker_failures=config.cluster_breaker_failures,
             breaker_cooldown_s=config.cluster_breaker_cooldown_ms / 1000.0,
             connect_timeout_s=config.cluster_connect_timeout_ms / 1000.0,
+            vnodes=config.cluster_vnodes,
+            replicate=config.cluster_replicate,
+            handoff_timeout_s=config.cluster_handoff_timeout_ms / 1000.0,
+            replica_cap=config.cluster_replica_cap,
         )
         metrics.set_cluster_stats_provider(limiter.peer_stats)
+        metrics.set_cluster_view_provider(limiter.cluster_view)
+        if config.cluster_vnodes > 0:
+            # Elastic capacity announcements: a degraded node shrinks
+            # its ring weight so neighbours absorb load; re-promotion
+            # restores it.  schedule-only (the hooks run under the
+            # limiter lock; the cluster pump applies them outside it).
+            cluster = limiter
+            supervisor.on_degrade = (
+                lambda: cluster.schedule_reweight(0.5)
+            )
+            supervisor.on_repromote = (
+                lambda: cluster.schedule_reweight(1.0)
+            )
     restore_snapshot_on_boot(limiter, config)
     # Front tier (L3.5): exact deny cache + admission control, shared
     # by the asyncio engine and the native transports.  Built after the
@@ -260,11 +277,17 @@ async def run_server(config: Config) -> None:
                 rpc_port,
                 limiter.local,
                 limiter.device_lock,
+                cluster=limiter,
             )
         )
 
     for transport in transports:
         await transport.start()
+
+    if cluster_nodes and config.cluster_vnodes > 0:
+        # Announce membership only once the RPC listener is up, so
+        # peers can stream our key range back (join/rejoin path).
+        limiter.start_membership()
 
     stop = asyncio.Event()
 
@@ -298,6 +321,10 @@ async def run_server(config: Config) -> None:
     log.info("shutting down")
     stop_task.cancel()
     await engine.shutdown()
+    if cluster_nodes:
+        # Stop the replica/membership pump and drop peer sockets before
+        # the snapshot, so no migration mutates the table under it.
+        limiter.close()
     for transport in transports:
         await transport.stop()
     if config.snapshot_path:

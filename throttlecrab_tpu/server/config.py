@@ -130,8 +130,8 @@ _SPEC = [
      "affected batches)"),
     ("faults", "THROTTLECRAB_FAULTS", "", str,
      "Fault injection spec site:mode[:arg],... — sites launch, fetch, "
-     "peer, keymap, snapshot; modes transient:p, persistent, count:n, "
-     "hang:seconds (empty: off; see throttlecrab_tpu/faults/)"),
+     "peer, keymap, snapshot, migrate; modes transient:p, persistent, "
+     "count:n, hang:seconds (empty: off; see throttlecrab_tpu/faults/)"),
     ("faults_seed", "THROTTLECRAB_FAULTS_SEED", 0, int,
      "Seed for the deterministic fault-injection probability stream"),
     ("cluster_nodes", "THROTTLECRAB_CLUSTER_NODES", "", str,
@@ -152,6 +152,27 @@ _SPEC = [
     ("cluster_breaker_cooldown_ms",
      "THROTTLECRAB_CLUSTER_BREAKER_COOLDOWN_MS", 1000, int,
      "Circuit-breaker cooldown before the next probe (milliseconds)"),
+    ("cluster_vnodes", "THROTTLECRAB_CLUSTER_VNODES", 128, int,
+     "Virtual nodes per cluster node on the consistent-hash ring "
+     "(elastic membership: join/leave only remaps the affected vnode "
+     "ranges).  0 is the kill switch: the legacy static crc32-modulo "
+     "routing, bit-identical to the pre-ring cluster tier.  MUST be "
+     "identical on every node — a mixed ring/modulo (or mixed-vnodes) "
+     "cluster splits key ownership"),
+    ("cluster_replicate", "THROTTLECRAB_CLUSTER_REPLICATE", True, bool,
+     "Warm-standby replication (ring mode): each node streams async "
+     "state deltas for its decided keys to their ring successor, so a "
+     "dead node's range keeps serving from the replica instead of "
+     "failing (env 0 disables; failover then starts those keys fresh)"),
+    ("cluster_handoff_timeout_ms",
+     "THROTTLECRAB_CLUSTER_HANDOFF_TIMEOUT_MS", 5000, int,
+     "How long a joining node holds decisions on a gained key range "
+     "waiting for the predecessor's migration before serving without "
+     "it (milliseconds)"),
+    ("cluster_replica_cap", "THROTTLECRAB_CLUSTER_REPLICA_CAP",
+     100_000, int,
+     "Bound on warm-standby replica rows held for ring predecessors "
+     "(overflow evicts the coldest row)"),
     # --- insight tier (L3.75: device-resident traffic analytics) --------
     ("insight", "THROTTLECRAB_INSIGHT", True, bool,
      "Insight tier: device-resident traffic analytics riding every "
@@ -235,6 +256,10 @@ class Config:
     cluster_connect_timeout_ms: int = 1000
     cluster_breaker_failures: int = 3
     cluster_breaker_cooldown_ms: int = 1000
+    cluster_vnodes: int = 128
+    cluster_replicate: bool = True
+    cluster_handoff_timeout_ms: int = 5000
+    cluster_replica_cap: int = 100_000
     insight: bool = True
     insight_topk: int = 64
     insight_sketch: int = 4096
@@ -360,6 +385,14 @@ class Config:
                 parse_spec(self.faults)
             except ValueError as e:
                 raise ConfigError(f"invalid --faults spec: {e}") from e
+        if self.cluster_vnodes < 0:
+            raise ConfigError(
+                "cluster_vnodes must be >= 0 (0 = legacy modulo routing)"
+            )
+        if self.cluster_handoff_timeout_ms <= 0:
+            raise ConfigError("cluster_handoff_timeout_ms must be > 0")
+        if self.cluster_replica_cap < 0:
+            raise ConfigError("cluster_replica_cap must be >= 0")
         nodes = self.cluster_node_list()
         if nodes:
             if not 0 <= self.cluster_index < len(nodes):

@@ -147,6 +147,17 @@ class HttpTransport(ConnTrackingMixin):
             state = self.engine.health_state()
             body = b"OK" if state == "ok" else state.encode()
             return 200, body, "text/plain"
+        if method == "GET" and path == "/health/cluster":
+            # The cluster view (ring deployments): membership epoch,
+            # per-peer breaker/migration state, handoff and replica
+            # status — what an operator needs mid-join or mid-failover.
+            # Single-node deployments answer {"mode": "none"} so
+            # pollers need no probe logic.
+            view_fn = getattr(self.engine.limiter, "cluster_view", None)
+            payload = json.dumps(
+                view_fn() if view_fn is not None else {"mode": "none"}
+            ).encode()
+            return 200, payload, "application/json"
         if method == "GET" and path == "/metrics":
             return (
                 200,
@@ -158,16 +169,20 @@ class HttpTransport(ConnTrackingMixin):
             # rates, top denied keys, hot-set concentration.  With the
             # tier disabled the shape still answers (enabled: false)
             # so pollers need no probe logic.
+            from .metrics import merge_cluster_stats
+
             insight = getattr(self.engine, "insight", None)
             if insight is None:
-                payload = json.dumps(
-                    {"insight": {"enabled": False}}
-                ).encode()
+                payload = json.dumps({"insight": {"enabled": False}})
             else:
                 payload = insight.stats_json(
                     state=self.engine.health_state()
-                ).encode()
-            return 200, payload, "application/json"
+                )
+            # Cluster deployments: membership/handoff/replica state and
+            # the per-peer counters ride the same poll (no-op and no
+            # re-serialize otherwise).
+            payload = merge_cluster_stats(payload, self.engine.limiter)
+            return 200, payload.encode(), "application/json"
         return 404, b"Not Found", "text/plain"
 
     async def _handle_throttle(self, body: bytes):

@@ -53,6 +53,13 @@ METRIC_NAMES = (
     "throttlecrab_tpu_supervisor_repromotes",
     "throttlecrab_cluster_forwarded_total",
     "throttlecrab_cluster_failed_total",
+    # Elastic cluster (ring mode, parallel/cluster.py + parallel/ring.py).
+    "throttlecrab_cluster_breaker_open",
+    "throttlecrab_cluster_migrated_keys",
+    "throttlecrab_cluster_migrated_in_total",
+    "throttlecrab_cluster_replica_rows",
+    "throttlecrab_cluster_takeovers_total",
+    "throttlecrab_cluster_epoch",
     # Insight tier (L3.75, insight/).
     "throttlecrab_tpu_insight_allowed_rate",
     "throttlecrab_tpu_insight_denied_rate",
@@ -282,9 +289,16 @@ class Metrics:
         self._insight_stats = provider
 
     def set_cluster_stats_provider(self, provider) -> None:
-        """`provider()` -> {peer_addr: {"forwarded": n, "failed": n}};
-        exported as per-peer counters (cluster deployments only)."""
+        """`provider()` -> {peer_addr: {"forwarded": n, "failed": n,
+        "breaker_open": 0|1, "migrated_keys": n}}; exported as per-peer
+        counters (cluster deployments only)."""
         self._cluster_stats = provider
+
+    def set_cluster_view_provider(self, provider) -> None:
+        """`provider()` -> ClusterLimiter.cluster_view(); exported as
+        the cluster-scalar gauges (epoch, replica rows, takeovers) and
+        served on GET /health/cluster (ring deployments only)."""
+        self._cluster_view = provider
 
     def set_tenant_stats_provider(self, provider) -> None:
         """`provider()` -> ShardedTpuRateLimiter.tenant_stats(); exported
@@ -525,20 +539,71 @@ class Metrics:
         provider = getattr(self, "_cluster_stats", None)
         if provider is not None:
             stats = provider()
-            for name, field, help_ in (
+            for name, field, typ, help_ in (
                 ("throttlecrab_cluster_forwarded_total", "forwarded",
-                 "Batches forwarded to each cluster peer"),
+                 "counter", "Batches forwarded to each cluster peer"),
                 ("throttlecrab_cluster_failed_total", "failed",
-                 "Forward failures per cluster peer"),
+                 "counter", "Forward failures per cluster peer"),
+                ("throttlecrab_cluster_breaker_open", "breaker_open",
+                 "gauge",
+                 "1 while the peer's circuit breaker is open (its key "
+                 "range is failing over to ring successors)"),
+                ("throttlecrab_cluster_migrated_keys", "migrated_keys",
+                 "counter",
+                 "Keys handed off to each peer by ring migrations "
+                 "(join/reweight/rejoin)"),
             ):
                 out.append(f"# HELP {name} {help_}")
-                out.append(f"# TYPE {name} counter")
+                out.append(f"# TYPE {name} {typ}")
                 for peer, counts in sorted(stats.items()):
                     escaped = escape_label_value(peer)
                     out.append(
-                        f'{name}{{peer="{escaped}"}} {counts[field]}'
+                        f'{name}{{peer="{escaped}"}} '
+                        f'{counts.get(field, 0)}'
                     )
+        view_provider = getattr(self, "_cluster_view", None)
+        if view_provider is not None:
+            view = view_provider()
+            metric(
+                "throttlecrab_cluster_epoch",
+                "Cluster membership epoch (bumps on join/reweight)",
+                "gauge",
+                view.get("epoch", 0),
+            )
+            metric(
+                "throttlecrab_cluster_migrated_in_total",
+                "Keys received through ring migrations",
+                "counter",
+                view.get("migrated_in", 0),
+            )
+            metric(
+                "throttlecrab_cluster_replica_rows",
+                "Warm-standby replica rows held for ring predecessors",
+                "gauge",
+                view.get("replica_rows", 0),
+            )
+            metric(
+                "throttlecrab_cluster_takeovers_total",
+                "Dead-peer ranges absorbed from the warm replica",
+                "counter",
+                view.get("takeovers", 0),
+            )
         return "\n".join(out) + "\n"
+
+
+def merge_cluster_stats(payload: str, limiter) -> str:
+    """Fold the cluster view into a /stats JSON payload (shared by the
+    python HTTP route and the native wire driver's pushed snapshot, so
+    the two transports cannot diverge).  Non-cluster limiters return
+    the payload untouched — no parse/re-serialize per poll."""
+    view_fn = getattr(limiter, "cluster_view", None)
+    if view_fn is None:
+        return payload
+    import json
+
+    stats = json.loads(payload)
+    stats["cluster"] = view_fn()
+    return json.dumps(stats)
 
 
 def escape_label_value(value: str) -> str:

@@ -635,7 +635,14 @@ class NativeRedisTransport:
         body = b"OK" if state == "ok" else state.encode()
         self._lib.ws_set_health(self._h, body, len(body))
         if self.insight is not None:
-            stats = self.insight.stats_json(state=state).encode()
+            from .metrics import merge_cluster_stats
+
+            # Cluster deployments: the membership/handoff/replica view
+            # rides the same pushed snapshot (shared helper keeps it in
+            # lockstep with the python HTTP route).
+            stats = merge_cluster_stats(
+                self.insight.stats_json(state=state), self.limiter
+            ).encode()
             self._lib.ws_set_stats(self._h, stats, len(stats))
 
     def _maybe_sweep(self, now_ns: int, n_ops: int) -> None:

@@ -337,6 +337,15 @@ class SupervisedLimiter:
         self.retry_count = 0
         self.degrade_count = 0
         self.repromote_count = 0
+        #: Capacity-change hooks (run_server wires these to the cluster
+        #: tier's schedule_reweight): a node whose device died serves
+        #: from the host oracle at a fraction of device throughput, so
+        #: it announces a reduced ring weight and its neighbours absorb
+        #: the difference; re-promotion restores it.  Called UNDER the
+        #: limiter lock, so hooks must only schedule work (never take
+        #: cluster locks inline).
+        self.on_degrade = None
+        self.on_repromote = None
 
         def params_of(fn):
             try:
@@ -515,6 +524,11 @@ class SupervisedLimiter:
         if self.metrics is not None:
             self.metrics.record_supervisor_degrade()
         self._set_state(STATE_DEGRADED)
+        if self.on_degrade is not None:
+            try:
+                self.on_degrade()
+            except Exception:
+                log.exception("on_degrade hook failed")
 
     def _probe_due(self, now_ns: int) -> bool:
         return now_ns - self._last_probe_ns >= self.probe_interval_ns
@@ -558,6 +572,11 @@ class SupervisedLimiter:
         if self.metrics is not None:
             self.metrics.record_supervisor_repromote()
         self._set_state(STATE_OK)
+        if self.on_repromote is not None:
+            try:
+                self.on_repromote()
+            except Exception:
+                log.exception("on_repromote hook failed")
         return True
 
     # -- the limiter API ------------------------------------------------ #
