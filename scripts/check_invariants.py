@@ -7,7 +7,11 @@ repo and report findings.
                                                   # findings or stale
                                                   # waivers
     python scripts/check_invariants.py --json     # machine-readable
-    python scripts/check_invariants.py --checks i64,twin
+                                                  # (per-checker
+                                                  # timings + stable
+                                                  # finding ids)
+    python scripts/check_invariants.py --checks i64,twin,lock
+    python scripts/check_invariants.py --max-seconds 30
 
 Pure stdlib and AST-based: finishes in seconds and must never import
 jax/numpy (verified at exit — the CI `invariants` job runs this on a
@@ -48,7 +52,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--checks",
         default="",
-        help="comma-separated subset of checkers (i64,twin,jit,registry)",
+        help="comma-separated subset of checkers "
+        "(i64,twin,jit,registry,lock,block,async)",
     )
     parser.add_argument(
         "--baseline",
@@ -56,6 +61,14 @@ def main(argv=None) -> int:
         default=None,
         help="waiver file (default: throttlecrab_tpu/analysis/"
         "baseline.toml under --root)",
+    )
+    parser.add_argument(
+        "--max-seconds",
+        type=float,
+        default=0.0,
+        help="runtime budget: exit 1 when the suite takes longer "
+        "(0 disables; CI pins 30 so the call-graph pass can't "
+        "silently balloon)",
     )
     args = parser.parse_args(argv)
 
@@ -67,7 +80,7 @@ def main(argv=None) -> int:
     CHECKERS = analysis.CHECKERS
     apply_baseline = analysis.apply_baseline
     load_baseline = analysis.load_baseline
-    run_all = analysis.run_all
+    run_timed = analysis.run_timed
 
     checks = None
     if args.checks:
@@ -86,7 +99,7 @@ def main(argv=None) -> int:
         )
 
     t0 = time.monotonic()
-    findings = run_all(args.root, checks=checks)
+    findings, timings = run_timed(args.root, checks=checks)
     waivers = load_baseline(baseline_path)
     if checks is not None:
         # Partial runs can't judge waiver staleness for skipped checkers.
@@ -105,10 +118,18 @@ def main(argv=None) -> int:
         print(
             json.dumps(
                 {
-                    "findings": [vars(f) for f in unwaived],
+                    # `id` is the stable finding identity
+                    # (path:symbol:rule, line fallback) so baselines
+                    # can be diffed mechanically across revisions
+                    # where line numbers move.
+                    "findings": [
+                        {**vars(f), "id": _finding_id(f)}
+                        for f in unwaived
+                    ],
                     "waived": len(findings) - len(unwaived),
                     "stale_waivers": [vars(w) for w in stale],
                     "elapsed_s": round(elapsed, 3),
+                    "checker_s": timings,
                     "jax_imported": jax_loaded,
                 },
                 indent=2,
@@ -136,9 +157,21 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.max_seconds and elapsed > args.max_seconds:
+        print(
+            f"invariants: runtime budget exceeded — {elapsed:.1f}s > "
+            f"{args.max_seconds:.0f}s "
+            f"(per-checker: {timings})",
+            file=sys.stderr,
+        )
+        return 1
     if args.strict and (unwaived or stale):
         return 1
     return 0
+
+
+def _finding_id(f) -> str:
+    return f"{f.path}:{f.symbol or f.line}:{f.code}"
 
 
 def _load_analysis():
@@ -164,7 +197,10 @@ def _codes_of(check_name: str):
         "i64": ("i64",),
         "twin": ("twin",),
         "jit": ("jit",),
-        "registry": ("knob", "metric"),
+        "registry": ("knob", "metric", "flag"),
+        "lock": ("lock",),
+        "block": ("block",),
+        "async": ("async",),
     }.get(check_name, ())
 
 

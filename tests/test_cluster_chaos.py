@@ -805,3 +805,122 @@ def test_three_node_join_kill_rejoin_acceptance():
                     p.wait(timeout=30)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+# ------------------------------------------------------------------ #
+# Lifecycle ops off the event loop (PR 11 async-boundary fix)
+
+
+def test_ring_and_join_ops_adopt_through_server():
+    """OP_RING and the OP_JOIN ack now run apply_ring / ring_state on
+    the lifecycle executor instead of the server's event loop (the
+    async-boundary checker pins the static half; this pins behavior):
+    per-connection ordering must survive the move — a ring broadcast
+    followed by a join on the SAME connection must see the adopted
+    weights in the ack."""
+    from throttlecrab_tpu.parallel.cluster import (
+        _HDR,
+        OP_RING,
+        OP_RING_STATE,
+        decode_ring,
+        encode_join,
+        encode_ring,
+    )
+
+    ports = free_ports(2)
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+    a = Node(0, nodes)  # peer 1 never starts: only the frames matter
+    try:
+        with a.cl._mu:
+            epoch0 = a.cl.epoch
+        s = socket.create_connection(("127.0.0.1", ports[0]), 5)
+        s.settimeout(30)
+        try:
+            # Weight broadcast, then a join announcement, pipelined on
+            # one connection.  The server must apply the ring BEFORE
+            # answering the join (op order == reply order).
+            s.sendall(encode_ring(OP_RING, epoch0 + 7, [1.0, 0.25]))
+            s.sendall(encode_join(1))
+            head = b""
+            while len(head) < _HDR.size:
+                head += s.recv(_HDR.size - len(head))
+            body_len, op = _HDR.unpack(head)
+            assert op == OP_RING_STATE
+            body = b""
+            while len(body) < body_len:
+                body += s.recv(body_len - len(body))
+            epoch, weights = decode_ring(body)
+            assert epoch >= epoch0 + 7
+            # Peer 1's announced weight was adopted; node 0 stays the
+            # authority for its own (1.0).
+            assert weights == [1.0, 0.25]
+        finally:
+            s.close()
+        with a.cl._mu:
+            assert a.cl.ring.weights[1] == 0.25
+    finally:
+        a.kill()
+
+
+def test_replica_push_failure_retries_next_live_successor():
+    """A replica push that fails (successor just died, or a stale
+    OP_JOIN heal re-closed its breaker before re-detection) must retry
+    once on the NEXT live successor instead of dropping the rows —
+    otherwise the absorbed range stays single-copy for the whole
+    re-detection window (the deterministic twin of the timing-
+    sensitive takeover test above)."""
+    ports = free_ports(3)
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+    lim = TpuRateLimiter(capacity=CAP)
+    cl = ClusterLimiter(lim, nodes, 0, vnodes=64, replicate=True)
+    try:
+        ring = cl.ring
+        # A key whose first successor (excluding self) is node 2 and
+        # whose next successor is node 1.
+        hot = next(
+            k for k in (f"rt:{i}".encode() for i in range(8000))
+            if ring.owner_of(k, exclude=frozenset({0})) == 2
+            and ring.owner_of(k, exclude=frozenset({0, 2})) == 1
+        )
+        sent = {1: [], 2: []}
+
+        class _P:
+            def __init__(self, idx, fail):
+                self.idx = idx
+                self.fail = fail
+                self.lock = threading.Lock()
+                self.breaker_open = False
+                self.failed = 0
+
+            def send_frame(self, frame):
+                if self.fail:
+                    raise ConnectionRefusedError(111, "refused")
+                sent[self.idx].append(frame)
+
+            def record_failure(self):
+                self.failed += 1
+
+            def close(self):
+                pass
+
+        cl.peers[1] = _P(1, fail=False)
+        cl.peers[2] = _P(2, fail=True)  # dies on the push
+        entry = (
+            [hot],
+            np.asarray([5], np.int64), np.asarray([100], np.int64),
+            np.asarray([60], np.int64), T0,
+            np.asarray([6 * NS], np.int64),
+            np.asarray([0], np.uint8), np.asarray([True], bool),
+            False,
+        )
+        cl._flush_replicas([entry])
+        assert sent[2] == []  # the first successor's push failed...
+        assert len(sent[1]) == 1  # ...and the rows landed on the next
+        from throttlecrab_tpu.parallel.cluster import decode_rows
+
+        _origin, _epoch, keys, _tats, _exps = decode_rows(
+            sent[1][0][5:]
+        )
+        assert keys == [hot]
+    finally:
+        cl.close()

@@ -917,3 +917,533 @@ class TestBaseline:
             parse_baseline("[[waiver]]\ncode = [1, 2]\n")
         with pytest.raises(ValueError):
             parse_baseline('code = "orphan key"\n')
+
+
+# ------------------------------------------------------------------ #
+# Concurrency checkers (lock / block / async)
+
+_LOCKORDER_FIXTURE = """\
+[[lock]]
+name = "outer"
+class = "Svc"
+rank = 10
+allow = "net"
+
+[[lock]]
+name = "inner"
+class = "Svc"
+rank = 20
+
+[[blocking]]
+call = "sendall"
+kind = "net"
+
+[[blocking]]
+call = "time.sleep"
+kind = "sleep"
+"""
+
+_SVC_REL = "throttlecrab_tpu/svc.py"
+_LOCKORDER_REL = "throttlecrab_tpu/analysis/lockorder.toml"
+
+_SVC_HEADER = """\
+import threading
+
+
+class Svc:
+    def __init__(self):
+        self.outer = threading.Lock()
+        self.inner = threading.Lock()
+
+"""
+
+
+def _conc_tree(tmp_path, body: str, toml: str = _LOCKORDER_FIXTURE):
+    _write(tmp_path, _SVC_REL, _SVC_HEADER + body)
+    if toml is not None:
+        _write(tmp_path, _LOCKORDER_REL, toml)
+    return tmp_path
+
+
+class TestLockOrder:
+    def test_real_tree_clean_with_baseline(self):
+        from throttlecrab_tpu.analysis import lock_order
+
+        findings = run_all(REPO, checks={"lock"})
+        waivers = load_baseline(DEFAULT_BASELINE)
+        unwaived, _ = apply_baseline(findings, waivers)
+        assert unwaived == [], "\n".join(f.format() for f in unwaived)
+        assert lock_order  # imported and runnable
+
+    def test_direct_inversion_flagged(self, tmp_path):
+        from throttlecrab_tpu.analysis import lock_order
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def bad(self):
+        with self.inner:
+            with self.outer:
+                pass
+""",
+        )
+        findings = lock_order.check(root)
+        hits = [f for f in findings if f.code == "lock-order"]
+        assert len(hits) == 1
+        assert hits[0].path == _SVC_REL
+        assert "Svc.outer" in hits[0].message
+        assert "Svc.inner" in hits[0].message
+
+    def test_canonical_order_passes(self, tmp_path):
+        from throttlecrab_tpu.analysis import lock_order
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def good(self):
+        with self.outer:
+            with self.inner:
+                pass
+""",
+        )
+        assert [
+            f for f in lock_order.check(root) if f.code == "lock-order"
+        ] == []
+
+    def test_transitive_inversion_through_call_graph(self, tmp_path):
+        """The PR-6/8 deadlock class: the nested acquisition hides one
+        call away — the graph must still surface it, with the witness
+        chain in the message."""
+        from throttlecrab_tpu.analysis import lock_order
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def helper(self):
+        with self.outer:
+            pass
+
+    def bad(self):
+        with self.inner:
+            self.helper()
+""",
+        )
+        hits = [
+            f
+            for f in lock_order.check(root)
+            if f.code == "lock-order"
+        ]
+        assert len(hits) == 1
+        assert "via" in hits[0].message and "helper" in hits[0].message
+
+    def test_sticky_acquire_region(self, tmp_path):
+        """.acquire() holds to end of function (the cluster held-dict
+        pattern): a later with-block on a lower rank must flag."""
+        from throttlecrab_tpu.analysis import lock_order
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def bad(self):
+        self.inner.acquire()
+        try:
+            with self.outer:
+                pass
+        finally:
+            self.inner.release()
+""",
+        )
+        assert any(
+            f.code == "lock-order" for f in lock_order.check(root)
+        )
+
+    def test_pragma_waives_inversion(self, tmp_path):
+        from throttlecrab_tpu.analysis import lock_order
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def audited(self):
+        with self.inner:
+            with self.outer:  # inv: allow(lock-order)
+                pass
+""",
+        )
+        assert [
+            f for f in lock_order.check(root) if f.code == "lock-order"
+        ] == []
+
+    def test_unranked_lock_flagged(self, tmp_path):
+        """A new threading.Lock creation site without a [[lock]] entry
+        must fail: every lock takes a position in the order."""
+        from throttlecrab_tpu.analysis import lock_order
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def boot(self):
+        self.extra = threading.Lock()
+""",
+        )
+        hits = [
+            f
+            for f in lock_order.check(root)
+            if f.code == "lock-unranked"
+        ]
+        assert len(hits) == 1
+        assert "Svc.extra" in hits[0].message
+
+    def test_stale_lockorder_decl_flagged(self, tmp_path):
+        """lockorder.toml staleness: an entry whose creation site is
+        gone fails, so the declaration tracks the tree."""
+        from throttlecrab_tpu.analysis import lock_order
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def nop(self):
+        pass
+""",
+            toml=_LOCKORDER_FIXTURE
+            + '\n[[lock]]\nname = "ghost"\nclass = "Gone"\nrank = 30\n',
+        )
+        hits = [
+            f
+            for f in lock_order.check(root)
+            if f.code == "lock-decl-stale"
+        ]
+        assert any("Gone.ghost" in f.message for f in hits)
+
+    def test_missing_lockorder_toml_is_loud(self, tmp_path):
+        from throttlecrab_tpu.analysis import lock_order
+
+        root = _conc_tree(tmp_path, "    pass\n", toml=None)
+        assert any(
+            f.code == "lock-config-missing"
+            for f in lock_order.check(root)
+        )
+
+
+class TestBlockingUnderLock:
+    def test_send_under_unsanctioned_lock_flagged(self, tmp_path):
+        """The PR-8 review-fix class: a socket send while a lock whose
+        allow list lacks `net` is held."""
+        from throttlecrab_tpu.analysis import blocking
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def push(self, sock):
+        with self.inner:
+            sock.sendall(b"x")
+""",
+        )
+        hits = [
+            f
+            for f in blocking.check(root)
+            if f.code == "block-under-lock"
+        ]
+        assert len(hits) == 1
+        assert "sendall" in hits[0].message
+        assert "Svc.inner" in hits[0].message
+
+    def test_allowed_kind_passes(self, tmp_path):
+        from throttlecrab_tpu.analysis import blocking
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def push(self, sock):
+        with self.outer:
+            sock.sendall(b"x")
+""",
+        )
+        assert blocking.check(root) == []
+
+    def test_transitive_blocking_flagged(self, tmp_path):
+        from throttlecrab_tpu.analysis import blocking
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def slow(self):
+        import time
+
+        time.sleep(1)
+
+    def bad(self):
+        with self.inner:
+            self.slow()
+""",
+        )
+        hits = [
+            f
+            for f in blocking.check(root)
+            if f.code == "block-under-lock"
+        ]
+        assert len(hits) == 1
+        assert "time.sleep" in hits[0].message
+        assert "via" in hits[0].message
+
+
+class TestAsyncBoundary:
+    def test_lock_across_await_flagged(self, tmp_path):
+        from throttlecrab_tpu.analysis import async_boundary
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    async def bad(self):
+        with self.inner:
+            await self.refresh()
+
+    async def refresh(self):
+        pass
+""",
+        )
+        hits = [
+            f
+            for f in async_boundary.check(root)
+            if f.code == "async-lock-await"
+        ]
+        assert len(hits) == 1
+        assert "Svc.inner" in hits[0].message
+
+    def test_ranked_lock_acquire_in_async_flagged(self, tmp_path):
+        from throttlecrab_tpu.analysis import async_boundary
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    async def bad(self):
+        with self.inner:
+            pass
+""",
+        )
+        assert any(
+            f.code == "async-lock-acquire"
+            for f in async_boundary.check(root)
+        )
+
+    def test_async_ok_lock_passes(self, tmp_path):
+        from throttlecrab_tpu.analysis import async_boundary
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    async def fine(self):
+        with self.leaf:
+            pass
+
+    def boot(self):
+        self.leaf = threading.Lock()
+""",
+            toml=_LOCKORDER_FIXTURE
+            + '\n[[lock]]\nname = "leaf"\nclass = "Svc"\nrank = 90\n'
+            + "async_ok = 1\n",
+        )
+        assert [
+            f
+            for f in async_boundary.check(root)
+            if f.code == "async-lock-acquire"
+        ] == []
+
+    def test_blocking_call_in_async_flagged(self, tmp_path):
+        from throttlecrab_tpu.analysis import async_boundary
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    async def bad(self):
+        import time
+
+        time.sleep(0.1)
+""",
+        )
+        hits = [
+            f
+            for f in async_boundary.check(root)
+            if f.code == "async-blocking-call"
+        ]
+        assert len(hits) == 1
+        assert "time.sleep" in hits[0].message
+
+    def test_executor_routed_blocking_passes(self, tmp_path):
+        """run_in_executor REFERENCES the blocking function; it must
+        not count as a loop-side call."""
+        from throttlecrab_tpu.analysis import async_boundary
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    async def fine(self, loop):
+        import time
+
+        await loop.run_in_executor(None, time.sleep, 0.1)
+""",
+        )
+        assert [
+            f
+            for f in async_boundary.check(root)
+            if f.code == "async-blocking-call"
+        ] == []
+
+    def test_transitive_lock_acquire_on_loop_flagged(self, tmp_path):
+        """The OP_RING class fixed this PR: an async handler calling a
+        sync helper that takes a ranked lock."""
+        from throttlecrab_tpu.analysis import async_boundary
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def adopt(self):
+        with self.inner:
+            pass
+
+    async def handle(self):
+        self.adopt()
+""",
+        )
+        hits = [
+            f
+            for f in async_boundary.check(root)
+            if f.code == "async-lock-acquire"
+        ]
+        assert len(hits) == 1
+        assert "via" in hits[0].message and "adopt" in hits[0].message
+
+    def test_loop_affine_api_from_thread_flagged(self, tmp_path):
+        from throttlecrab_tpu.analysis import async_boundary
+
+        root = _conc_tree(
+            tmp_path,
+            """\
+    def worker(self):
+        import asyncio
+
+        asyncio.get_running_loop()
+
+    async def spawn(self, loop):
+        await loop.run_in_executor(None, self.worker)
+""",
+        )
+        hits = [
+            f
+            for f in async_boundary.check(root)
+            if f.code == "async-loop-affinity"
+        ]
+        assert len(hits) == 1
+        assert "get_running_loop" in hits[0].message
+
+
+class TestRegistryParity:
+    _CONFIG = """\
+    _SPEC = [
+        ("cluster_vnodes", "THROTTLECRAB_CLUSTER_VNODES", 128, int,
+         "vnodes"),
+        ("shards", "THROTTLECRAB_NSHARDS", 1, int, "shards"),
+    ]
+    """
+
+    def _tree(self, tmp_path, readme: str) -> Path:
+        _write(
+            tmp_path,
+            "throttlecrab_tpu/server/config.py",
+            self._CONFIG,
+        )
+        _write(
+            tmp_path,
+            "throttlecrab_tpu/server/metrics.py",
+            'METRIC_NAMES = ()\n',
+        )
+        (tmp_path / "README.md").write_text(readme)
+        return tmp_path
+
+    def test_flag_knob_mismatch_flagged(self, tmp_path):
+        """--shards paired with THROTTLECRAB_NSHARDS: the canonical
+        derivation is THROTTLECRAB_SHARDS — both directions of the
+        flag<->knob contract break, so it fails."""
+        root = self._tree(
+            tmp_path,
+            "`THROTTLECRAB_CLUSTER_VNODES` and `THROTTLECRAB_NSHARDS`\n",
+        )
+        findings = registry.check(root)
+        hits = [f for f in findings if f.code == "flag-knob-mismatch"]
+        assert len(hits) == 1
+        assert "THROTTLECRAB_SHARDS" in hits[0].message
+        assert "--shards" in hits[0].message
+
+    def test_matching_flag_knob_passes(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            "`THROTTLECRAB_CLUSTER_VNODES` and `THROTTLECRAB_NSHARDS`\n",
+        )
+        findings = registry.check(root)
+        assert not any(
+            f.code == "flag-knob-mismatch"
+            and "cluster_vnodes" in f.message
+            for f in findings
+        )
+
+    def test_documented_but_unread_knob_flagged(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            "`THROTTLECRAB_CLUSTER_VNODES`, `THROTTLECRAB_NSHARDS`,\n"
+            "and `THROTTLECRAB_GHOST_KNOB` control things\n",
+        )
+        findings = registry.check(root)
+        hits = [f for f in findings if f.code == "knob-stale"]
+        assert len(hits) == 1
+        assert "THROTTLECRAB_GHOST_KNOB" in hits[0].message
+        assert hits[0].path == "README.md"
+        assert hits[0].line == 2
+
+    def test_wildcard_doc_reference_is_not_a_knob(self, tmp_path):
+        """Prose like `THROTTLECRAB_CLUSTER_*` names a family, not a
+        knob — it must not produce a stale-doc finding."""
+        root = self._tree(
+            tmp_path,
+            "`THROTTLECRAB_CLUSTER_VNODES`, `THROTTLECRAB_NSHARDS`;\n"
+            "see the `THROTTLECRAB_CLUSTER_*` family and the\n"
+            "`THROTTLECRAB_*` prefix convention\n",
+        )
+        findings = registry.check(root)
+        assert not any(f.code == "knob-stale" for f in findings)
+
+
+class TestCliOutput:
+    def test_json_carries_timings_and_stable_ids(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO / "scripts" / "check_invariants.py"),
+                "--json",
+                "--checks",
+                "lock,block,async",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert set(report["checker_s"]) == {"lock", "block", "async"}
+        for f in report["findings"]:
+            assert f["id"].count(":") >= 2
+
+    def test_runtime_budget_enforced(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO / "scripts" / "check_invariants.py"),
+                "--checks",
+                "twin",
+                "--max-seconds",
+                "0.000001",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 1
+        assert "runtime budget exceeded" in proc.stderr

@@ -480,3 +480,76 @@ def test_boot_restore_strict_refuses_nonstrict_starts_empty(tmp_path):
     assert restore_snapshot_on_boot(lim2, Config(
         http=True, snapshot_path=str(good)
     )) == 40
+
+
+def test_run_server_snapshot_lifecycle_off_the_loop(tmp_path):
+    """End-to-end run_server lifecycle: the boot restore and the
+    shutdown save now run on the executor instead of the event loop
+    (PR 11 async-boundary fix) — the snapshot must still round-trip
+    through a full serve/SIGINT/reboot cycle, and the second boot must
+    serve with the restored table."""
+    import asyncio
+    import json as _json
+    import os
+    import signal
+    import socket as _socket
+
+    from throttlecrab_tpu.server.__main__ import run_server
+    from throttlecrab_tpu.server.config import Config
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    snap = tmp_path / "lifecycle.npz"
+
+    async def _post_throttle(key):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        payload = _json.dumps(
+            {
+                "key": key, "max_burst": 3, "count_per_period": 1,
+                "period": 3600, "quantity": 1,
+            }
+        ).encode()
+        writer.write(
+            (
+                "POST /throttle HTTP/1.1\r\nHost: x\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode()
+            + payload
+        )
+        await writer.drain()
+        raw = await reader.read(-1)
+        writer.close()
+        return _json.loads(raw.partition(b"\r\n\r\n")[2])
+
+    async def lifecycle(expect_remaining):
+        cfg = Config(
+            http=True,
+            http_host="127.0.0.1",
+            http_port=port,
+            snapshot_path=str(snap),
+        )
+        task = asyncio.create_task(run_server(cfg))
+        body = None
+        for _ in range(400):
+            if task.done():
+                task.result()  # surface boot failures
+            try:
+                body = await _post_throttle("lifecycle-key")
+                break
+            except OSError:
+                await asyncio.sleep(0.05)
+        assert body is not None, "server never came up"
+        # burst 3, one emission per hour: a fresh bucket's first allow
+        # leaves remaining=2; a RESTORED bucket already spent one, so
+        # its first allow on the rebooted server leaves remaining=1.
+        assert body["allowed"] is True
+        assert body["remaining"] == expect_remaining
+        os.kill(os.getpid(), signal.SIGINT)
+        await asyncio.wait_for(task, timeout=60)
+
+    asyncio.run(lifecycle(expect_remaining=2))
+    assert snap.exists()
+    asyncio.run(lifecycle(expect_remaining=1))

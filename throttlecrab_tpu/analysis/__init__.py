@@ -19,9 +19,23 @@ those invariants mechanically, on every PR, in seconds:
     values and host calls (``time.*``, ``np.random``, I/O) inside
     ``@jax.jit``/Pallas-decorated functions;
   * ``registry``     — every ``THROTTLECRAB_*`` knob the package reads
-    must be documented (README/ARCHITECTURE), and every
-    ``throttlecrab_*`` metric emitted must match the
-    ``server/metrics.py`` METRIC_NAMES registry (both directions).
+    must be documented (README/ARCHITECTURE), every documented knob
+    must still be read, every ``config._SPEC`` CLI flag must pair with
+    its canonically-named env knob, and every ``throttlecrab_*``
+    metric emitted must match the ``server/metrics.py`` METRIC_NAMES
+    registry (both directions);
+  * ``lock``         — every nested lock acquisition, threaded through
+    a conservative intra-package call graph, validated against the
+    canonical total order in ``lockorder.toml`` (inversions and
+    therefore cycles fail; new/removed locks ratchet the declaration);
+  * ``block``        — blocking calls (socket send/recv, device
+    launch/fetch, ``sleep``, ``Future.result``, subprocess…) reachable
+    while a ranked lock is held must be kinds that lock's audited
+    ``allow`` list sanctions — the PR-8 send-under-device_lock class;
+  * ``async``        — no threading lock held across ``await``, no
+    ranked non-``async_ok`` lock or blocking call on the event loop
+    outside ``run_in_executor``, no loop-affine asyncio API from
+    executor threads.
 
 Pure stdlib, AST-based plus a small C++ token scanner: importing this
 package (or running ``scripts/check_invariants.py``) must never import
@@ -32,11 +46,20 @@ next to this file; the suite ratchets from zero unwaived findings.
 
 from __future__ import annotations
 
+import time
 from pathlib import Path
-from typing import List
+from typing import Dict, List, Tuple
 
 from .common import Finding, apply_baseline, load_baseline
-from . import i64_hygiene, jit_boundary, registry, twin_drift
+from . import (
+    async_boundary,
+    blocking,
+    i64_hygiene,
+    jit_boundary,
+    lock_order,
+    registry,
+    twin_drift,
+)
 
 #: name -> check(root) callables, in report order.
 CHECKERS = {
@@ -44,20 +67,35 @@ CHECKERS = {
     "twin": twin_drift.check,
     "jit": jit_boundary.check,
     "registry": registry.check,
+    "lock": lock_order.check,
+    "block": blocking.check,
+    "async": async_boundary.check,
 }
 
 DEFAULT_BASELINE = Path(__file__).with_name("baseline.toml")
 
 
-def run_all(root, checks=None) -> List[Finding]:
-    """Run the selected checkers (default: all) over a repo tree."""
+def run_timed(
+    root, checks=None
+) -> Tuple[List[Finding], Dict[str, float]]:
+    """Run the selected checkers (default: all); findings plus
+    per-checker wall time (the CI budget assertion and ``--json``
+    timings both read it)."""
     root = Path(root)
     findings: List[Finding] = []
+    timings: Dict[str, float] = {}
     for name, fn in CHECKERS.items():
         if checks is None or name in checks:
+            t0 = time.monotonic()
             findings.extend(fn(root))
+            timings[name] = round(time.monotonic() - t0, 3)
     findings.sort(key=lambda f: (f.path, f.line, f.code))
-    return findings
+    return findings, timings
+
+
+def run_all(root, checks=None) -> List[Finding]:
+    """Run the selected checkers (default: all) over a repo tree."""
+    return run_timed(root, checks=checks)[0]
 
 
 __all__ = [
@@ -67,4 +105,5 @@ __all__ = [
     "apply_baseline",
     "load_baseline",
     "run_all",
+    "run_timed",
 ]

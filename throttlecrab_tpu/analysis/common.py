@@ -77,41 +77,61 @@ class Waiver:
 
 _TOML_STR = re.compile(r'^(\w+)\s*=\s*"((?:[^"\\]|\\.)*)"\s*$')
 _TOML_INT = re.compile(r"^(\w+)\s*=\s*(\d+)\s*$")
+_TOML_TABLE = re.compile(r"^\[\[(\w+)\]\]$")
 
 
-def parse_baseline(text: str) -> List[Waiver]:
-    """Parse the baseline's TOML subset: comments, blank lines, and
-    ``[[waiver]]`` tables of string/int scalar keys.  Anything else is
-    a hard error — the file is part of the invariant surface."""
-    waivers: List[Waiver] = []
+def parse_tables(
+    text: str, file_label: str = "baseline.toml"
+) -> Dict[str, List[Dict[str, object]]]:
+    """Parse the analysis TOML subset shared by ``baseline.toml`` and
+    ``lockorder.toml``: comments, blank lines, and ``[[name]]`` array
+    tables of string/int scalar keys.  Returns ``{table_name: [entry
+    dicts, ...]}``; each entry carries its table's source line under
+    the reserved ``_line`` key (error messages point at the right
+    table).  Anything else is a hard error — these files are part of
+    the invariant surface, not a place for silent typos."""
+    out: Dict[str, List[Dict[str, object]]] = {}
     current: Optional[Dict[str, object]] = None
     for n, raw in enumerate(text.splitlines(), 1):
         line = raw.strip()
         if not line or line.startswith("#"):
             continue
-        if line == "[[waiver]]":
-            if current is not None:
-                waivers.append(_build_waiver(current, n))
-            current = {}
+        table = _TOML_TABLE.match(line)
+        if table is not None:
+            current = {"_line": n}
+            out.setdefault(table.group(1), []).append(current)
             continue
         m = _TOML_STR.match(line)
         if m is None:
             m = _TOML_INT.match(line)
             if m is None:
                 raise ValueError(
-                    f"baseline.toml:{n}: unsupported syntax: {raw!r}"
+                    f"{file_label}:{n}: unsupported syntax: {raw!r}"
                 )
             key, value = m.group(1), int(m.group(2))
         else:
             key, value = m.group(1), _unescape(m.group(2))
         if current is None:
             raise ValueError(
-                f"baseline.toml:{n}: key outside a [[waiver]] table"
+                f"{file_label}:{n}: key outside a [[...]] table"
             )
         current[key] = value
-    if current is not None:
-        waivers.append(_build_waiver(current, 0))
-    return waivers
+    return out
+
+
+def parse_baseline(text: str) -> List[Waiver]:
+    """Parse the baseline's TOML subset (``[[waiver]]`` tables of
+    string/int scalars) into Waiver records."""
+    tables = parse_tables(text, "baseline.toml")
+    unknown = set(tables) - {"waiver"}
+    if unknown:
+        raise ValueError(
+            f"baseline.toml: unknown table(s) {sorted(unknown)}"
+        )
+    return [
+        _build_waiver(entry, int(entry.pop("_line", 0)))  # type: ignore[arg-type]
+        for entry in tables.get("waiver", [])
+    ]
 
 
 def _unescape(s: str) -> str:

@@ -13,6 +13,16 @@ Two user-facing name surfaces accrete silently:
     ``server/metrics.py`` (``metric-unregistered``), and every registry
     entry must still be emitted somewhere (``metric-stale``) — the
     registry is the dashboard contract, so both directions are drift.
+  * **Flag ↔ knob parity** — every CLI flag row in ``server/config.py``
+    ``_SPEC`` must pair with its canonically-derived env knob
+    (``--cluster-vnodes`` ↔ ``THROTTLECRAB_CLUSTER_VNODES``); a row
+    whose env name diverges from the flag name is
+    ``flag-knob-mismatch``.  And the reverse direction: every
+    ``THROTTLECRAB_*`` name the docs reference must still be read
+    somewhere in the package (``knob-stale``) — documentation for a
+    knob that no longer exists misconfigures every deployment that
+    trusts it.  Wildcard doc references (``THROTTLECRAB_*``) are
+    prose, not knobs, and are skipped.
 
 String literals are collected from the AST (full-string matches only,
 so prose mentions inside docstrings don't count as reads), including
@@ -30,13 +40,20 @@ from typing import Dict, List, Optional, Set, Tuple
 from .common import Finding, PyModule, iter_py_files
 
 KNOB_UNDOCUMENTED = "knob-undocumented"
+KNOB_STALE = "knob-stale"
+FLAG_KNOB_MISMATCH = "flag-knob-mismatch"
 METRIC_UNREGISTERED = "metric-unregistered"
 METRIC_STALE = "metric-stale"
 REGISTRY_MISSING = "metric-registry-missing"
 
 PACKAGE_DIR = "throttlecrab_tpu"
 METRICS_PY = "throttlecrab_tpu/server/metrics.py"
+CONFIG_PY = "throttlecrab_tpu/server/config.py"
 DOC_FILES = ("README.md", "ARCHITECTURE.md")
+
+#: A documented knob reference: full env-var name NOT followed by a
+#: wildcard (`THROTTLECRAB_CLUSTER_*` is prose for a family).
+_DOC_KNOB = re.compile(r"THROTTLECRAB_[A-Z0-9_]*[A-Z0-9](?![A-Z0-9_*])")
 
 _KNOB = re.compile(r"^THROTTLECRAB_[A-Z0-9_]+$")
 _METRIC = re.compile(r"^throttlecrab_[a-z0-9_]+")
@@ -142,6 +159,33 @@ def _registry(mod: PyModule) -> Tuple[Set[str], int, int]:
     return set(), 0, 0
 
 
+def _spec_rows(mod: PyModule) -> List[Tuple[str, str, int]]:
+    """(flag name, env name, line) rows of the config.py _SPEC table."""
+    rows: List[Tuple[str, str, int]] = []
+    for stmt in mod.tree.body:
+        if not (
+            isinstance(stmt, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "_SPEC"
+                for t in stmt.targets
+            )
+            and isinstance(stmt.value, ast.List)
+        ):
+            continue
+        for elt in stmt.value.elts:
+            if not isinstance(elt, ast.Tuple) or len(elt.elts) < 2:
+                continue
+            name_n, env_n = elt.elts[0], elt.elts[1]
+            if (
+                isinstance(name_n, ast.Constant)
+                and isinstance(name_n.value, str)
+                and isinstance(env_n, ast.Constant)
+                and isinstance(env_n.value, str)
+            ):
+                rows.append((name_n.value, env_n.value, elt.lineno))
+    return rows
+
+
 def check(root) -> List[Finding]:
     root = Path(root)
     findings: List[Finding] = []
@@ -149,6 +193,7 @@ def check(root) -> List[Finding]:
     knob_sites: Dict[str, Tuple[str, int]] = {}
     metric_occ: Dict[str, List[Tuple[str, int]]] = {}
     metrics_mod: Optional[PyModule] = None
+    config_mod: Optional[PyModule] = None
     for rel in iter_py_files(root, PACKAGE_DIR):
         try:
             mod = PyModule.load(root, rel)
@@ -156,6 +201,8 @@ def check(root) -> List[Finding]:
             continue
         if rel == METRICS_PY:
             metrics_mod = mod
+        if rel == CONFIG_PY:
+            config_mod = mod
         knobs, metrics = _collect_strings(mod)
         for name, line in knobs.items():
             knob_sites.setdefault(name, (rel, line))
@@ -166,10 +213,15 @@ def check(root) -> List[Finding]:
 
     # ---- knobs vs docs ------------------------------------------- #
     docs = ""
+    doc_knob_lines: Dict[str, Tuple[str, int]] = {}
     for doc in DOC_FILES:
         path = root / doc
         if path.exists():
-            docs += path.read_text()
+            text = path.read_text()
+            docs += text
+            for n, line in enumerate(text.splitlines(), 1):
+                for m in _DOC_KNOB.finditer(line):
+                    doc_knob_lines.setdefault(m.group(0), (doc, n))
     for name in sorted(knob_sites):
         rel, line = knob_sites[name]
         # Word-boundary match: THROTTLECRAB_HTTP must not count as
@@ -186,6 +238,42 @@ def check(root) -> List[Finding]:
                     ),
                 )
             )
+    # Reverse direction: a documented knob nobody reads misconfigures
+    # every deployment that trusts the docs.
+    for name in sorted(set(doc_knob_lines) - set(knob_sites)):
+        doc, line = doc_knob_lines[name]
+        findings.append(
+            Finding(
+                code=KNOB_STALE,
+                path=doc,
+                line=line,
+                message=(
+                    f"documented knob {name} is never read anywhere "
+                    "in the package — stale documentation (or a "
+                    "dropped knob that deployments may still set)"
+                ),
+            )
+        )
+
+    # ---- CLI-flag <-> env-knob parity (config._SPEC) -------------- #
+    if config_mod is not None:
+        for flag, env, line in _spec_rows(config_mod):
+            want = "THROTTLECRAB_" + flag.upper()
+            if env != want:
+                findings.append(
+                    Finding(
+                        code=FLAG_KNOB_MISMATCH,
+                        path=CONFIG_PY,
+                        line=line,
+                        message=(
+                            f"flag --{flag.replace('_', '-')} pairs "
+                            f"with env knob {env}, but the canonical "
+                            f"derivation is {want} — a flag whose knob "
+                            "diverges breaks the CLI>env>default "
+                            "precedence contract both directions"
+                        ),
+                    )
+                )
 
     # ---- metrics vs registry ------------------------------------- #
     if metrics_mod is None:

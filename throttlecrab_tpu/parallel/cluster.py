@@ -1585,17 +1585,47 @@ class ClusterLimiter(ScalarCompatMixin):
         with self._mu:
             epoch = self.epoch
         for d, (ks, ts, es) in by_dest.items():
-            peer = self.peers[d]
-            if peer is None or peer.breaker_open:
-                continue
-            frame = encode_rows(OP_REPLICA, self.self_index, epoch, ks, ts, es)
-            try:
-                with peer.lock:
-                    peer.send_frame(frame)
-            except (OSError, PeerUnavailable) as e:
-                # Best-effort: a failed replica push costs nothing but
-                # staleness; the breaker bookkeeping still learns.
-                _note_peer_error(peer, e)
+            if not self._push_replica_rows(d, epoch, ks, ts, es):
+                # The successor refused/failed: these rows would leave
+                # their range single-copy (the exact takeover window a
+                # replica exists for), so retry ONCE on the next live
+                # successor instead of dropping.  A breaker heal racing
+                # a node death (a stale OP_JOIN processed after the
+                # kill re-closes the breaker) otherwise routes the
+                # absorbed range's replicas at the dead node for the
+                # whole re-detection window.
+                excl2 = excl | self._dead_peers() | {d}
+                if len(excl2) >= len(self.nodes):
+                    continue
+                succ2 = ring.owners_of(batch_crc32(ks), exclude=excl2)
+                redo: dict = {}
+                for j, e2 in enumerate(succ2):
+                    e2 = int(e2)
+                    if e2 == self.self_index:
+                        continue
+                    rows = redo.setdefault(e2, ([], [], []))
+                    rows[0].append(ks[j])
+                    rows[1].append(ts[j])
+                    rows[2].append(es[j])
+                for e2, (ks2, ts2, es2) in redo.items():
+                    self._push_replica_rows(e2, epoch, ks2, ts2, es2)
+
+    def _push_replica_rows(self, dest: int, epoch, ks, ts, es) -> bool:
+        """One best-effort OP_REPLICA push; False when the peer is
+        down/refusing (breaker bookkeeping done)."""
+        peer = self.peers[dest]
+        if peer is None or peer.breaker_open:
+            return False
+        frame = encode_rows(OP_REPLICA, self.self_index, epoch, ks, ts, es)
+        try:
+            with peer.lock:
+                peer.send_frame(frame)
+            return True
+        except (OSError, PeerUnavailable) as e:
+            # A failed replica push costs nothing but staleness; the
+            # breaker bookkeeping still learns.
+            _note_peer_error(peer, e)
+            return False
 
     def announce_join_to(self, d: int, register_pending: bool = True):
         """OP_JOIN round trip to one peer: adopt its ring state and gate
@@ -2136,12 +2166,26 @@ class ClusterServer:
         # all blocked in _wait_handoff — could starve the very
         # apply_migrate call that releases them.
         self._lifecycle_pool = None
+        # Ring-state ops (OP_RING adoption, the OP_JOIN ack snapshot)
+        # are pure host work under _mu — milliseconds, never network —
+        # but they must not run on the event loop (a contended _mu
+        # would stall every connection) NOR share the lifecycle pool
+        # (an on_join there can legitimately block on peer I/O for its
+        # whole request_lock window, and an ack queued behind it turns
+        # into a cross-node join convoy — observed as a breaker heal
+        # landing seconds late).  One dedicated worker keeps them both
+        # off the loop and unstarvable.
+        self._ring_pool = None
         if cluster is not None and cluster.ring is not None:
             from concurrent.futures import ThreadPoolExecutor
 
             self._lifecycle_pool = ThreadPoolExecutor(
                 max_workers=2,
                 thread_name_prefix="throttlecrab-cluster-lifecycle",
+            )
+            self._ring_pool = ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix="throttlecrab-cluster-ring",
             )
 
     async def start(self) -> None:
@@ -2171,6 +2215,8 @@ class ClusterServer:
                 pass
         if self._lifecycle_pool is not None:
             self._lifecycle_pool.shutdown(wait=False)
+        if self._ring_pool is not None:
+            self._ring_pool.shutdown(wait=False)
 
     @property
     def bound_port(self) -> int:
@@ -2245,7 +2291,14 @@ class ClusterServer:
                     continue
                 if op == OP_RING:
                     epoch, weights = decode_ring(body)
-                    cl.apply_ring(epoch, weights)
+                    # The ring rebuild (vnodes x nodes hash pass) and
+                    # its _mu hold run on the dedicated ring executor,
+                    # never the event loop — a decide thread holding
+                    # _mu mid-flip would stall every connection this
+                    # loop serves.
+                    await loop.run_in_executor(
+                        self._ring_pool, cl.apply_ring, epoch, weights,
+                    )
                     continue
                 if op == OP_JOIN:
                     origin = decode_join(body)
@@ -2255,7 +2308,15 @@ class ClusterServer:
                     # would deadlock two nodes joining each other
                     # (each ack blocked on a migrate whose connection
                     # the other side's announce is still holding).
-                    epoch, weights = cl.ring_state()
+                    # ring_state takes _mu — off the loop, but on the
+                    # DEDICATED ring executor, never the lifecycle
+                    # pool: an on_join occupying that pool can block
+                    # on peer I/O for its whole request_lock window,
+                    # and an ack queued behind it convoys every
+                    # concurrent join in the cluster.
+                    epoch, weights = await loop.run_in_executor(
+                        self._ring_pool, cl.ring_state
+                    )
                     writer.write(
                         encode_ring(OP_RING_STATE, epoch, weights)
                     )

@@ -229,7 +229,13 @@ async def run_server(config: Config) -> None:
             supervisor.on_repromote = (
                 lambda: cluster.schedule_reweight(1.0)
             )
-    restore_snapshot_on_boot(limiter, config)
+    loop = asyncio.get_running_loop()
+    # The restore is a device bulk-insert (and, on a corrupt snapshot,
+    # a full sweep): executor, not the event loop — by the time the
+    # cluster RPC listener starts serving below, the loop must be free.
+    await loop.run_in_executor(
+        None, restore_snapshot_on_boot, limiter, config
+    )
     # Front tier (L3.5): exact deny cache + admission control, shared
     # by the asyncio engine and the native transports.  Built after the
     # snapshot restore on purpose — the cache must start empty against
@@ -295,7 +301,6 @@ async def run_server(config: Config) -> None:
         log.info("shutdown signal received")
         stop.set()
 
-    loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
             loop.add_signal_handler(sig, _signal_handler)
@@ -330,9 +335,16 @@ async def run_server(config: Config) -> None:
     if config.snapshot_path:
         from ..tpu.snapshot import save_snapshot
 
-        try:
+        def locked_save() -> int:
+            # The lock serializes against any straggling native driver
+            # thread; transports are already stopped, so holding it
+            # across the file write is shutdown-only by construction.
             with engine.limiter_lock:
-                saved = save_snapshot(limiter, config.snapshot_path)
+                return save_snapshot(limiter, config.snapshot_path)  # inv: allow(block-under-lock)
+
+        try:
+            # Device export + .npz write: executor, not the event loop.
+            saved = await loop.run_in_executor(None, locked_save)
             log.info(
                 "saved %d keys to snapshot %s",
                 saved, config.snapshot_path,
