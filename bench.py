@@ -196,6 +196,18 @@ def main() -> int:
                          "hardware), insight+tenants ON vs OFF, same "
                          "session; benches/mesh_scaling.py owns the "
                          "full D=1/2/4/8 sweep")
+    ap.add_argument("--replay", action="store_true",
+                    help="record/replay A/B instead: one synthetic "
+                         "flash-crowd trace (throttlecrab_tpu/replay) "
+                         "replayed against two limiter configs in THIS "
+                         "session — the exact same-session A/B shape "
+                         "docs/benchmark-results.md prescribes against "
+                         "the ±2x session-variance caveat; verifies "
+                         "the two configs' outcome vectors are "
+                         "bit-identical before timing them")
+    ap.add_argument("--replay-trace", default="",
+                    help="with --replay: replay this trace file "
+                         "instead of synthesizing one")
     args = ap.parse_args()
 
     if args.mesh:
@@ -242,6 +254,8 @@ def main() -> int:
         return run_mesh_bench(args, device)
     if args.cluster:
         return run_cluster_bench(args)
+    if args.replay:
+        return run_replay_bench(args, device)
     pallas_interpreted = args.pallas and device.platform != "tpu"
     if pallas_interpreted:
         print(
@@ -575,6 +589,81 @@ def run_insight_bench(args, device) -> int:
         )
     )
     return 0
+
+
+def run_replay_bench(args, device) -> int:
+    """Record/replay same-session A/B (ISSUE 14): one trace — synthetic
+    flash-crowd by default, or any recorded trace via --replay-trace —
+    replayed against two limiter configs in one session.
+
+    The two configs here are the insight kill-switch pair (analytics
+    accumulators on vs off): replay first PROVES their outcome vectors
+    are bit-identical (the kill-switch contract, now checked under a
+    replayable workload instead of a bespoke test harness), then times
+    each side over the identical decision stream.  Unlike the live A/B
+    benches, both sides consume the same keys, params and timestamps by
+    construction — the trace is the controlled variable the ±2x
+    session-variance caveat in docs/benchmark-results.md asks for."""
+    from throttlecrab_tpu.replay.generators import synthesize
+    from throttlecrab_tpu.replay.player import outcome_vector, replay
+    from throttlecrab_tpu.replay.trace import Trace
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    if args.replay_trace:
+        trace = Trace.load(args.replay_trace)
+        source = args.replay_trace
+    else:
+        trace = synthesize(
+            "flash-crowd",
+            windows=24 if args.quick else 96,
+            batch=512 if args.quick else 2048,
+            key_space=4096 if args.quick else 32768,
+            seed=17,
+        )
+        source = "synthetic flash-crowd"
+    cap = 1 << 17
+
+    def measure(insight: bool):
+        limiter = TpuRateLimiter(
+            capacity=cap, keymap="python", insight=insight
+        )
+        outcomes = replay(trace, limiter)  # warm pass: compiles + grows
+        vec = outcome_vector(outcomes)
+        limiter2 = TpuRateLimiter(
+            capacity=cap, keymap="python", insight=insight
+        )
+        t0 = time.perf_counter()
+        replay(trace, limiter2)
+        elapsed = time.perf_counter() - t0
+        return trace.n_rows() / elapsed, vec
+
+    # Best of 2 per mode (the repo bench idiom), same trace both sides.
+    rate_off, vec_off = max(
+        (measure(False) for _ in range(2)), key=lambda rv: rv[0]
+    )
+    rate_on, vec_on = max(
+        (measure(True) for _ in range(2)), key=lambda rv: rv[0]
+    )
+    identical = vec_off == vec_on
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "replay A/B decisions/s (one trace, two configs, "
+                    f"same session; {source}, "
+                    f"{len(trace.windows)} windows, "
+                    f"{trace.n_rows()} rows)"
+                ),
+                "insight_off": round(rate_off),
+                "insight_on": round(rate_on),
+                "unit": "decisions/s",
+                "overhead_frac": round(1.0 - rate_on / rate_off, 4),
+                "outcomes_bit_identical": identical,
+                "platform": device.platform,
+            }
+        )
+    )
+    return 0 if identical else 1
 
 
 def run_cluster_bench(args) -> int:

@@ -437,6 +437,90 @@ def run_cluster_frame_fuzz(seed, iters):
     return done
 
 
+def run_trace_frame_fuzz(seed, iters):
+    """Malformed-frame hardening for the record/replay trace codec
+    (throttlecrab_tpu/replay/trace.py): random truncations, byte flips,
+    splices and explicit count-vs-size lies over valid traces must
+    either decode cleanly or raise the typed TraceError — never
+    struct.error/IndexError/MemoryError, and never size an allocation
+    from an attacker-controlled count (a trace file is untrusted input:
+    it may come off a crashed node or a bug report).  Returns the
+    number of mutated inputs exercised."""
+    import struct as _struct
+
+    from throttlecrab_tpu.replay.trace import (
+        Trace,
+        TraceError,
+        TraceWriter,
+        decode_event,
+        decode_injection,
+        decode_window,
+    )
+
+    rng = np.random.default_rng(seed)
+    frame_decoders = (decode_window, decode_event, decode_injection)
+    done = 0
+    for _ in range(iters):
+        writer = TraceWriter()
+        for _w in range(int(rng.integers(1, 4))):
+            n = int(rng.integers(0, 10))
+            keys = [
+                bytes(rng.integers(0, 256, int(rng.integers(0, 24)),
+                                   dtype=np.uint8))
+                for _ in range(n)
+            ]
+            writer.add_window(
+                int(rng.integers(0, 2**62)), int(rng.integers(0, 32)),
+                keys,
+                rng.integers(-(2**40), 2**40, (n, 4)),
+                rng.integers(0, 2, n), rng.integers(0, 6, n),
+                rng.integers(0, 2**16, n),
+            )
+        if rng.random() < 0.5:
+            writer.add_event(
+                int(rng.integers(0, 2**62)), "degrade", "x" * 5
+            )
+        if rng.random() < 0.5:
+            writer.add_injection(
+                "launch", "count", int(rng.integers(0, 1000)), 1.5
+            )
+        data = bytearray(writer.to_bytes())
+        mode = rng.random()
+        if mode < 0.30 and len(data) > 6:          # truncate
+            data = data[: int(rng.integers(6, len(data)))]
+        elif mode < 0.60 and len(data) > 6:        # flip bytes
+            for _ in range(int(rng.integers(1, 5))):
+                data[int(rng.integers(6, len(data)))] = int(
+                    rng.integers(256)
+                )
+        elif mode < 0.75:                          # append garbage
+            data += bytes(
+                rng.integers(0, 256, int(rng.integers(1, 24)),
+                             dtype=np.uint8)
+            )
+        elif mode < 0.9 and len(data) >= 6 + 5 + 13:
+            # Explicit count-vs-size lie: overwrite the first window
+            # frame's n field with a huge value (the decode_batch leak
+            # class the PR-8 cluster fuzzer caught).
+            _struct.pack_into(
+                "<I", data, 6 + 5 + 9, int(rng.integers(2**20, 2**31))
+            )
+        try:
+            Trace.loads(bytes(data))
+        except TraceError:
+            pass  # the typed rejection the trace contract promises
+        # Bare frame bodies through each decoder (no file header).
+        body = bytes(data[6:])
+        dec = frame_decoders[int(rng.integers(len(frame_decoders)))]
+        try:
+            dec(body[: int(rng.integers(0, max(len(body), 1) + 1))])
+        except TraceError:
+            pass
+        done += 1
+        TOTAL["requests"] += 1
+    return done
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seeds", type=int, default=24)
@@ -475,6 +559,14 @@ def main() -> int:
         n = run_cluster_frame_fuzz(5000 + s, args.steps * 40)
         print(
             f"cluster-frame seed {5000 + s} ok — {n} frames",
+            file=sys.stderr, flush=True,
+        )
+    # Record/replay trace hardening: mutated trace files and bare
+    # frames must fail with the typed TraceError, never crash.
+    for s in range(args.seeds):
+        n = run_trace_frame_fuzz(6000 + s, args.steps * 20)
+        print(
+            f"trace-frame seed {6000 + s} ok — {n} inputs",
             file=sys.stderr, flush=True,
         )
     print(

@@ -646,10 +646,16 @@ RPC_PORTS = (28490, 28491, 28492)
 NODES3 = ",".join(f"127.0.0.1:{p}" for p in RPC_PORTS)
 
 
-def spawn_node3(index: int):
+def spawn_node3(index: int, trace_dir: str = ""):
     env = dict(os.environ)
     env["THROTTLECRAB_PLATFORM"] = "cpu"
     env["THROTTLECRAB_CLUSTER_TIMEOUT_MS"] = "60000"
+    if trace_dir:
+        # Full-capture flight recorder: every decided window lands in
+        # this node's trace file (finalized on graceful shutdown), so
+        # the soak's timeline is replayable after the fact.
+        env["THROTTLECRAB_TRACE_DIR"] = trace_dir
+        env["THROTTLECRAB_TRACE_MODE"] = "full"
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.Popen(
@@ -704,17 +710,41 @@ def cluster_view3(port):
 
 
 @pytest.mark.slow
-def test_three_node_join_kill_rejoin_acceptance():
+def test_three_node_join_kill_rejoin_acceptance(tmp_path):
     """The end-to-end elastic lifecycle on three real server processes:
     sustained load survives a node join (zero failed requests, ranges
     migrate) and a node kill (zero failed requests on the replicated
     range — an exhausted key stays denied through takeover), and the
     killed node rejoins with the absorbed state migrated back.  This is
-    the CI acceptance gate for the elastic path."""
+    the CI acceptance gate for the elastic path.
+
+    Record -> replay pass (ISSUE 14): every node runs with the
+    full-capture flight recorder armed; after the soak, the three
+    nodes' traces are merged by server timestamp and checked for
+    conservation against the client's own observation — every decision
+    the client saw appears in the recorded timeline exactly once, with
+    the same outcome, in the same per-key order (zero lost or
+    double-counted decisions across join, kill and rejoin)."""
+    from collections import defaultdict
+
     from throttlecrab_tpu.parallel.ring import HashRing
 
+    trace_dirs = [str(tmp_path / f"node{i}") for i in range(3)]
+    for d in trace_dirs:
+        os.makedirs(d, exist_ok=True)
+    #: Client ground truth: key -> [allowed, ...] in request order.
+    client_log = defaultdict(list)
+
+    def throttle3t(port, key, **kw):
+        doc = throttle3(port, key, **kw)
+        client_log[key].append(bool(doc["allowed"]))
+        return doc
+
     ring3 = HashRing(NODES3.split(","), 128)
-    procs = [spawn_node3(0), spawn_node3(1), None]
+    procs = [
+        spawn_node3(0, trace_dirs[0]), spawn_node3(1, trace_dirs[1]),
+        None,
+    ]
     try:
         wait_healthy3(procs[0], HTTP_PORTS[0])
         wait_healthy3(procs[1], HTTP_PORTS[1])
@@ -724,11 +754,11 @@ def test_three_node_join_kill_rejoin_acceptance():
         # Steady state through both frontends (also warms compiles).
         for step in range(4):
             for k in pool:
-                throttle3(HTTP_PORTS[step % 2], k, burst=50, count=100,
+                throttle3t(HTTP_PORTS[step % 2], k, burst=50, count=100,
                           period=60)
 
         # ---- JOIN under load ---------------------------------------- #
-        procs[2] = spawn_node3(2)
+        procs[2] = spawn_node3(2, trace_dirs[2])
         join_allowed = []
         deadline = time.time() + 180
         joined = False
@@ -736,7 +766,7 @@ def test_three_node_join_kill_rejoin_acceptance():
             for k in pool:
                 try:
                     join_allowed.append(
-                        throttle3(HTTP_PORTS[0], k, burst=50, count=100,
+                        throttle3t(HTTP_PORTS[0], k, burst=50, count=100,
                                   period=60)["allowed"]
                     )
                 except urllib.error.HTTPError:
@@ -755,7 +785,7 @@ def test_three_node_join_kill_rejoin_acceptance():
         assert failures == 0, f"{failures} client failures during join"
         # One more pass so traffic flows through the 3-node ring.
         for k in pool:
-            throttle3(HTTP_PORTS[2], k, burst=50, count=100, period=60)
+            throttle3t(HTTP_PORTS[2], k, burst=50, count=100, period=60)
         view = cluster_view3(HTTP_PORTS[0])
         assert view["mode"] == "ring"
 
@@ -766,7 +796,7 @@ def test_three_node_join_kill_rejoin_acceptance():
         )
         # Exhaust it on the 3-node cluster (burst 2): 2 allowed, rest
         # denied; replica deltas flow to the successor.
-        seq = [throttle3(HTTP_PORTS[2], hot, burst=2)["allowed"]
+        seq = [throttle3t(HTTP_PORTS[2], hot, burst=2)["allowed"]
                for _ in range(4)]
         assert seq == [True, True, False, False]
         time.sleep(2.0)  # replica pump cadence
@@ -775,7 +805,7 @@ def test_three_node_join_kill_rejoin_acceptance():
         # Zero client-visible failures on the dead range, and the
         # exhausted key STAYS denied — the warm replica carried its TAT.
         for i in range(3):
-            r = throttle3(HTTP_PORTS[i % 2], hot, burst=2)
+            r = throttle3t(HTTP_PORTS[i % 2], hot, burst=2)
             assert r["allowed"] is False, (
                 "takeover lost the replicated state"
             )
@@ -783,18 +813,63 @@ def test_three_node_join_kill_rejoin_acceptance():
             k for k in (f"freshacc:{i}" for i in range(10_000))
             if ring3.owner_of(k.encode()) == 2
         )
-        assert throttle3(HTTP_PORTS[0], fresh, burst=5)["allowed"] is True
+        assert throttle3t(HTTP_PORTS[0], fresh, burst=5)["allowed"] is True
         views = [cluster_view3(HTTP_PORTS[i]) for i in range(2)]
         assert any(v["takeovers"] >= 1 for v in views), views
 
         # ---- REJOIN ------------------------------------------------- #
-        procs[2] = spawn_node3(2)
+        procs[2] = spawn_node3(2, trace_dirs[2])
         wait_healthy3(procs[2], HTTP_PORTS[2])
         time.sleep(1.0)
         # The rejoined node serves its range from the migrated-back
         # state: still denied on its own frontend.
-        assert throttle3(HTTP_PORTS[2], hot, burst=2)["allowed"] is False
-        assert throttle3(HTTP_PORTS[0], hot, burst=2)["allowed"] is False
+        assert throttle3t(HTTP_PORTS[2], hot, burst=2)["allowed"] is False
+        assert throttle3t(HTTP_PORTS[0], hot, burst=2)["allowed"] is False
+
+        # ---- RECORD -> REPLAY: conservation over the merged traces -- #
+        # Graceful shutdown finalizes each node's full-capture trace
+        # file (incl. node 2's pre-kill file: SIGTERM closed it).
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.terminate()
+        for p in procs:
+            if p is not None:
+                p.wait(timeout=60)
+        import glob as _glob
+
+        from throttlecrab_tpu.replay.trace import Trace
+
+        rows = []
+        for d in trace_dirs:
+            for path in _glob.glob(os.path.join(d, "*.tctr")):
+                for w in Trace.load(path).windows:
+                    for j in range(len(w)):
+                        rows.append((
+                            w.now_ns,
+                            w.keys[j].decode(),
+                            bool(w.allowed[j]),
+                            int(w.status[j]),
+                        ))
+        # Merge the three nodes' timelines by the server-side window
+        # timestamp (one wall clock: same host).  The client is serial,
+        # so per-key order is total.
+        rows.sort(key=lambda r: r[0])
+        recorded = defaultdict(list)
+        for _t, key, was_allowed, status in rows:
+            assert status == 0, (key, status)
+            recorded[key].append(was_allowed)
+        # Conservation: every decision the client observed appears in
+        # the recorded timeline exactly once (nothing lost to the kill
+        # or the migrations, nothing double-counted by forwarding),
+        # with the same outcome, in the same per-key order.
+        assert set(recorded) == set(client_log), (
+            set(recorded) ^ set(client_log)
+        )
+        for key, seq_client in client_log.items():
+            assert recorded[key] == seq_client, (
+                f"replayed timeline for {key!r} diverged: "
+                f"{recorded[key]} != {seq_client}"
+            )
     finally:
         for p in procs:
             if p is not None and p.poll() is None:
@@ -805,6 +880,119 @@ def test_three_node_join_kill_rejoin_acceptance():
                     p.wait(timeout=30)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+# --------------------------------------------- record -> replay #
+
+
+def test_cluster_record_replay_join_kill_rejoin():
+    """Record/replay over the elastic lifecycle (ISSUE 14): a 3-node
+    in-process cluster captures its client-visible decisions and
+    membership timeline into one trace (join -> kill -> rejoin), and a
+    ClusterReplayer reconstructs the membership from the recorded
+    events and replays the identical outcome vector — zero lost or
+    double-counted decisions from the replayed timeline (an exhausted
+    key must stay denied across the takeover in the replay too)."""
+    from throttlecrab_tpu.replay.player import (
+        ClusterReplayer,
+        outcome_vector,
+    )
+    from throttlecrab_tpu.replay.recorder import FlightRecorder, arm, disarm
+    from throttlecrab_tpu.replay.trace import Trace
+
+    ports = free_ports(3)
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+    recorder = FlightRecorder(capacity=4096, out_dir="/tmp")
+    arm(recorder)
+    a = Node(0, nodes)
+    b = Node(1, nodes)
+    c = None
+    b2 = None
+    replayer = None
+    try:
+        a.join_cluster()
+        b.join_cluster()
+        for n in (a, b):
+            n.cl.capture = True
+        ring = a.cl.ring
+        pool = [f"rr:{i}" for i in range(32)]
+        hot = next(
+            k for k in (f"rrhot:{i}" for i in range(4000))
+            if ring.owner_of(k.encode()) == 1
+        )
+        now = T0
+        frontends = [a, b]
+        for step in range(6):
+            via = frontends[step % len(frontends)]
+            via.cl.rate_limit_batch(pool, 8, 100, 60, 1, now)
+            now += NS // 4
+
+        # JOIN under load: node 2 boots, announces, serves.
+        c = Node(2, nodes)
+        c.cl.capture = True
+        c.join_cluster()
+        frontends = [a, b, c]
+        for step in range(6):
+            via = frontends[step % len(frontends)]
+            via.cl.rate_limit_batch(pool, 8, 100, 60, 1, now)
+            now += NS // 4
+
+        # Exhaust the hot key on its owner; replica flows to successor.
+        for i in range(4):
+            res = b.cl.rate_limit_batch([hot], 2, 2, 600, 1, now + i)
+        now += 4
+        assert not res.allowed[0], "precondition: hot key exhausted"
+        successor = ring.owner_of(hot.encode(), exclude=frozenset({1}))
+        succ_node = {0: a, 2: c}[successor]
+        deadline = time.monotonic() + 8
+        while (
+            time.monotonic() < deadline
+            and hot.encode() not in succ_node.cl.replica_store
+        ):
+            time.sleep(0.1)
+        assert hot.encode() in succ_node.cl.replica_store
+
+        # KILL: the successor absorbs; exhausted key stays denied.
+        b.kill()
+        for i in range(3):
+            res = a.cl.rate_limit_batch([hot], 2, 2, 600, 1, now)
+            assert res.status[0] == 0 and not res.allowed[0]
+            now += NS // 4
+        a.cl.rate_limit_batch(pool, 8, 100, 60, 1, now)
+        now += NS // 4
+
+        # REJOIN: fresh node 1, state migrated back, still denied.
+        b2 = Node(1, nodes)
+        b2.cl.capture = True
+        b2.join_cluster()
+        res = b2.cl.rate_limit_batch([hot], 2, 2, 600, 1, now)
+        assert res.status[0] == 0 and not res.allowed[0]
+        now += NS // 4
+        b2.cl.rate_limit_batch(pool, 8, 100, 60, 1, now)
+
+        path, _n = recorder.dump()
+        disarm()
+        trace = Trace.load(path)
+        kinds = [e.kind for e in trace.events]
+        assert "cluster-join" in kinds and "cluster-takeover" in kinds
+
+        # Replay the whole timeline on a fresh in-process cluster.
+        replayer = ClusterReplayer(3, capacity=CAP)
+        replayed = replayer.replay(trace, settle_s=1.0)
+        assert outcome_vector(replayed) == trace.outcome_vector(), (
+            "replayed cluster timeline drifted from the recorded "
+            "outcomes (lost or double-counted decisions)"
+        )
+    finally:
+        disarm()
+        if replayer is not None:
+            replayer.close()
+        for n in (a, b, c, b2):
+            if n is not None:
+                try:
+                    n.kill()
+                except Exception:
+                    pass
 
 
 # ------------------------------------------------------------------ #

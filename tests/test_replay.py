@@ -1,0 +1,646 @@
+"""Record/replay subsystem (ISSUE 14, throttlecrab_tpu/replay/).
+
+Contracts under test:
+
+- **Trace codec hardening** — the cluster codecs' malformed-frame
+  contract verbatim: count-vs-size before allocation, typed TraceError
+  (never struct.error), trailing-bytes rejection, version gating.
+- **Record -> replay determinism** — a workload captured through the
+  real batching engine replays byte-identically (two replays produce
+  identical outcome vectors) and faithfully (replay == recorded).
+- **Differential replay** — replayed outcomes match the scalar oracle
+  row-for-row under tier-fuzz-shaped traffic (degenerate probes,
+  param churn, hostile params).
+- **Deterministic fault replay** — a chaos run's fired-injection
+  sequence is captured into the trace, and replaying it through
+  FaultInjector.from_schedule reproduces the identical outcome vector
+  AND the identical fired sequence (degrade -> recover lifecycle
+  included).
+- **Flight recorder** — bounded ring, dump-on-degrade through the
+  supervisor, GET /trace/dump admin route, fired-injection metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from throttlecrab_tpu.replay.generators import save, synthesize
+from throttlecrab_tpu.replay.player import (
+    differential_replay,
+    injector_from_trace,
+    make_target,
+    outcome_vector,
+    replay,
+)
+from throttlecrab_tpu.replay.recorder import (
+    FlightRecorder,
+    arm,
+    disarm,
+)
+from throttlecrab_tpu.replay.trace import (
+    SOURCE_ENGINE,
+    Trace,
+    TraceError,
+    TraceWriter,
+    decode_event,
+    decode_injection,
+    decode_window,
+    encode_event,
+    encode_injection,
+    encode_window,
+)
+
+NS = 1_000_000_000
+T0 = 1_753_700_000 * NS
+
+
+@pytest.fixture(autouse=True)
+def _disarm_recorder():
+    yield
+    disarm()
+
+
+# ------------------------------------------------------------ codec #
+
+
+def test_window_roundtrip_preserves_everything():
+    keys = [b"a", b"tenant:zz", b"", b"x" * 300]
+    params = np.array(
+        [[5, 100, 60, 1], [2, 2, 600, 0], [1, 1, 1, 1],
+         [3_000_000_000, 1, 1, 1]],
+        np.int64,
+    )
+    frame = encode_window(
+        T0, 7, keys, params, [1, 0, 1, 0], [0, 0, 2, 3], [0, 3, 0, 1]
+    )
+    w = decode_window(frame[5:])
+    assert w.now_ns == T0 and w.source == 7
+    assert w.keys == keys
+    np.testing.assert_array_equal(w.params, params)
+    assert w.allowed.tolist() == [1, 0, 1, 0]
+    assert w.status.tolist() == [0, 0, 2, 3]
+    assert w.tenants.tolist() == [0, 3, 0, 1]
+
+
+def test_event_and_injection_roundtrip():
+    e = decode_event(encode_event(T0, "degrade", "UNAVAILABLE: boom")[5:])
+    assert (e.now_ns, e.kind, e.detail) == (
+        T0, "degrade", "UNAVAILABLE: boom"
+    )
+    i = decode_injection(encode_injection("launch", "count", 7, 2.0)[5:])
+    assert (i.site, i.mode, i.index, i.arg) == ("launch", "count", 7, 2.0)
+
+
+def test_trace_file_roundtrip_and_order():
+    writer = TraceWriter()
+    writer.add_event(T0, "cluster-join", "1")
+    writer.add_window(
+        T0 + 1, SOURCE_ENGINE, [b"k"], [[5, 100, 60, 1]], [1], [0]
+    )
+    writer.add_injection("launch", "transient", 3, 0.5)
+    writer.add_window(
+        T0 + 2, SOURCE_ENGINE, [b"k"], [[5, 100, 60, 1]], [0], [0]
+    )
+    trace = Trace.loads(writer.to_bytes())
+    kinds = [k for k, _ in trace.records]
+    assert kinds == [2, 1, 3, 1]  # capture order survives
+    assert len(trace.windows) == 2
+    assert trace.injection_schedule() == [("launch", "transient", 3, 0.5)]
+
+
+def test_codec_rejection_fixtures():
+    """Every malformed shape raises the typed TraceError — never a raw
+    struct.error/IndexError (the PR-8 decode_batch leak class)."""
+    writer = TraceWriter()
+    writer.add_window(
+        T0, SOURCE_ENGINE, [b"ab", b"c"],
+        [[5, 100, 60, 1], [5, 100, 60, 1]], [1, 1], [0, 0],
+    )
+    data = writer.to_bytes()
+
+    with pytest.raises(TraceError):  # bad magic
+        Trace.loads(b"XXXX" + data[4:])
+    with pytest.raises(TraceError):  # unsupported version
+        Trace.loads(data[:4] + b"\x63\x00" + data[6:])
+    with pytest.raises(TraceError):  # truncated frame header
+        Trace.loads(data[:8])
+    with pytest.raises(TraceError):  # truncated frame body
+        Trace.loads(data[:-3])
+    with pytest.raises(TraceError):  # unknown record kind
+        bad = bytearray(data)
+        bad[10] = 200
+        Trace.loads(bytes(bad))
+
+    # Count-vs-size lie: n patched huge must be refused BEFORE any
+    # allocation sized from it.
+    lie = bytearray(data)
+    struct.pack_into("<I", lie, 6 + 5 + 9, 1 << 30)
+    with pytest.raises(TraceError):
+        Trace.loads(bytes(lie))
+
+    # Trailing bytes inside a window frame body.
+    frame = encode_window(
+        T0, 0, [b"k"], [[5, 100, 60, 1]], [1], [0]
+    )
+    with pytest.raises(TraceError):
+        decode_window(frame[5:] + b"\x00")
+    with pytest.raises(TraceError):
+        decode_window(frame[5: -1])
+    with pytest.raises(TraceError):
+        decode_event(b"")
+    with pytest.raises(TraceError):
+        decode_injection(b"\x01")
+    ev = encode_event(T0, "x", "y")
+    with pytest.raises(TraceError):
+        decode_event(ev[5:] + b"z")
+
+
+def test_oversized_key_refused_at_encode():
+    with pytest.raises(TraceError):
+        encode_window(
+            T0, 0, [b"x" * 70_000], [[5, 100, 60, 1]], [1], [0]
+        )
+
+
+# ------------------------------------------------- flight recorder #
+
+
+def test_ring_keeps_last_n_windows_and_all_events(tmp_path):
+    rec = FlightRecorder(capacity=4, out_dir=str(tmp_path))
+    for i in range(10):
+        rec.record_window(
+            T0 + i, [f"k{i}"], [[5, 100, 60, 1]], [1], [0]
+        )
+    rec.record_event("degrade", "boom", now_ns=T0 + 99)
+    path, n = rec.dump()
+    assert n == 4
+    trace = Trace.load(path)
+    assert [w.keys[0] for w in trace.windows] == [
+        b"k6", b"k7", b"k8", b"k9"
+    ]
+    # The event survives ring overflow (bounded side list).
+    assert [e.kind for e in trace.events] == ["degrade"]
+
+
+def test_full_mode_records_every_window(tmp_path):
+    path = str(tmp_path / "full.tctr")
+    rec = FlightRecorder(
+        capacity=2, mode="full", out_dir=str(tmp_path), path=path
+    )
+    for i in range(9):
+        rec.record_window(
+            T0 + i, [b"k%d" % i], [[5, 100, 60, 1]], [1], [0]
+        )
+    rec.close()
+    trace = Trace.load(path)
+    assert len(trace.windows) == 9  # full mode ignores the ring bound
+
+
+def test_full_mode_late_capture_never_truncates(tmp_path):
+    """Review-fix regression: a capture arriving after close() must be
+    dropped — reopening the finalized file would truncate the artifact
+    the recorder exists to preserve."""
+    path = str(tmp_path / "late.tctr")
+    rec = FlightRecorder(
+        mode="full", out_dir=str(tmp_path), path=path
+    )
+    for i in range(3):
+        rec.record_window(T0 + i, [b"k"], [[5, 100, 60, 1]], [1], [0])
+    rec.close()
+    rec.record_window(T0 + 9, [b"late"], [[5, 100, 60, 1]], [1], [0])
+    rec.record_event("cluster-reweight", "0:0.5")
+    assert len(Trace.load(path).windows) == 3  # artifact untouched
+
+
+def test_capture_never_raises_into_serving(tmp_path):
+    """Review-fix regression: an over-long key (past the trace's u16
+    bound) is truncated at capture, never raised into the hot path."""
+    rec = FlightRecorder(capacity=4, out_dir=str(tmp_path))
+    rec.record_window(
+        T0, [b"x" * 70_000, b"ok"], [[5, 100, 60, 1]] * 2,
+        [1, 1], [0, 0],
+    )
+    path, n = rec.dump()
+    assert n == 1
+    w = Trace.load(path).windows[0]
+    assert len(w.keys[0]) == 0xFFFF and w.keys[1] == b"ok"
+
+
+def test_scheduled_injector_multi_firing_per_index():
+    """Review-fix regression: one live check can fire several armed
+    specs (a hang that stalls, then a transient that raises); replay
+    must reproduce all of them at that index, in order."""
+    from throttlecrab_tpu.faults import FaultInjector, InjectedDeviceError
+
+    slept = []
+    inj = FaultInjector.from_schedule(
+        [("launch", "hang", 0, 0.25), ("launch", "transient", 0, 0.9)],
+        sleep_fn=slept.append,
+    )
+    with pytest.raises(InjectedDeviceError):
+        inj.check("launch")
+    assert slept == [0.25]  # the stall replayed before the raise
+    assert [(m, i) for _s, m, i, _a in inj.fired_schedule()] == [
+        ("hang", 0), ("transient", 0)
+    ]
+
+
+def test_recorder_derives_tenant_ids(tmp_path):
+    rec = FlightRecorder(capacity=8, out_dir=str(tmp_path))
+    rec.record_window(
+        T0, [b"acme:k1", b"globex:k2", b"bare", b"acme:k3"],
+        [[5, 100, 60, 1]] * 4, [1, 1, 1, 1], [0, 0, 0, 0],
+    )
+    path, _ = rec.dump()
+    w = Trace.load(path).windows[0]
+    assert w.tenants[0] == w.tenants[3] != 0  # same tenant, same id
+    assert w.tenants[1] not in (0, w.tenants[0])
+    assert w.tenants[2] == 0  # bare key: no tenant
+
+
+# -------------------------------------- record -> replay (engine) #
+
+
+async def _drive_engine(windows: int, now_step_ns: int = NS // 2):
+    from throttlecrab_tpu.harness.workload import make_keys
+    from throttlecrab_tpu.server.engine import BatchingEngine
+    from throttlecrab_tpu.server.types import ThrottleRequest
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    clock = {"now": T0}
+    engine = BatchingEngine(
+        TpuRateLimiter(capacity=2048), batch_size=32,
+        max_linger_us=200, now_fn=lambda: clock["now"],
+    )
+    keys = make_keys("hotkey-abuse", windows * 32, 1000, seed=5)
+    for step in range(windows):
+        reqs = [
+            ThrottleRequest(k, 4, 10, 60, 1)
+            for k in keys[step * 32: (step + 1) * 32]
+        ]
+        await asyncio.gather(
+            *[engine.throttle(r) for r in reqs], return_exceptions=True
+        )
+        clock["now"] += now_step_ns
+    await engine.shutdown()
+
+
+def test_engine_record_then_replay_byte_identical(tmp_path):
+    """The acceptance core: capture through the real engine flush path,
+    replay twice, diff byte-for-byte; replay also equals the recorded
+    outcomes and the scalar oracle."""
+    path = str(tmp_path / "eng.tctr")
+    rec = FlightRecorder(
+        mode="full", out_dir=str(tmp_path), path=path
+    )
+    arm(rec)
+    try:
+        asyncio.run(_drive_engine(10))
+    finally:
+        rec.close()
+        disarm()
+    trace = Trace.load(path)
+    assert trace.n_rows() == 10 * 32
+
+    v1 = outcome_vector(replay(trace, make_target("device", trace)))
+    v2 = outcome_vector(replay(trace, make_target("device", trace)))
+    assert v1 == v2, "two replays of one trace diverged"
+    assert v1 == trace.outcome_vector(), "replay != recorded outcomes"
+
+    report = differential_replay(trace, "device")
+    assert report.ok, report.summary()
+
+
+def test_disarmed_engine_records_nothing(tmp_path):
+    assert FlightRecorder(capacity=4).windows_recorded == 0
+    asyncio.run(_drive_engine(2))  # no recorder armed: must not blow up
+
+
+# -------------------------------------------- differential replay #
+
+
+def _hostile_trace():
+    """Tier-fuzz-shaped traffic as a trace: degenerate probes
+    (quantity 0), burst-1 (tolerance 0), cur-only params, invalid
+    lanes, duplicate keys in one window, param churn mid-stream."""
+    writer = TraceWriter()
+    rng = np.random.default_rng(23)
+    pool = [b"hz:%d" % i for i in range(12)]
+    profiles = [
+        (1, 5, 30, 1),              # burst 1: tolerance 0
+        (5, 100, 60, 0),            # quantity-0 probe
+        (3000, 60, 60, 1),          # cur tier only
+        (0, 10, 60, 1),             # invalid params (burst 0)
+        (4, 10, 60, 1),
+        (2, 2, 600, 1),
+    ]
+    now = T0
+    for step in range(30):
+        n = int(rng.integers(2, 16))
+        ks, ps = [], []
+        for _ in range(n):
+            ks.append(pool[int(rng.integers(len(pool)))])
+            ps.append(profiles[int(rng.integers(len(profiles)))])
+        writer.add_window(
+            now, SOURCE_ENGINE, ks, np.asarray(ps, np.int64),
+            np.zeros(n, np.uint8), np.zeros(n, np.uint8),
+        )
+        now += int(rng.integers(0, NS))
+    return Trace.loads(writer.to_bytes())
+
+
+def test_differential_replay_hostile_patterns_device():
+    trace = _hostile_trace()
+    got = replay(trace, make_target("device", trace))
+    want = replay(trace, make_target("oracle", trace))
+    for wi, ((ga, gs), (wa, ws)) in enumerate(zip(got, want)):
+        np.testing.assert_array_equal(gs, ws, err_msg=f"window {wi}")
+        ok = ws == 0
+        np.testing.assert_array_equal(
+            ga[ok], wa[ok], err_msg=f"window {wi}"
+        )
+
+
+def test_differential_replay_synthetic_patterns_sharded():
+    from conftest import require_devices
+
+    require_devices(2)
+    for pattern in ("diurnal", "flash-crowd", "slow-drift"):
+        trace = synthesize(
+            pattern, windows=8, batch=48, key_space=512, seed=3
+        )
+        report = differential_replay(trace, "sharded:2")
+        assert report.ok, (pattern, report.summary())
+
+
+def test_generated_trace_saves_and_replays(tmp_path):
+    trace = synthesize(
+        "diurnal", windows=6, batch=32, key_space=256, seed=9
+    )
+    path = str(tmp_path / "syn.tctr")
+    save(trace, path)
+    loaded = Trace.load(path)
+    assert loaded.outcome_vector() == trace.outcome_vector()
+    report = differential_replay(loaded, "device")
+    assert report.ok, report.summary()
+
+
+# --------------------------------------- deterministic fault replay #
+
+
+def _supervised_chaos_run(injector, recorder=None):
+    """One degrade -> recover lifecycle under a supervised limiter with
+    `injector` armed; returns the per-window outcome planes."""
+    from throttlecrab_tpu.faults import arm as arm_faults
+    from throttlecrab_tpu.faults import disarm as disarm_faults
+    from throttlecrab_tpu.server.supervisor import SupervisedLimiter
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    lim = TpuRateLimiter(capacity=512)
+    lim.rate_limit_batch(["__warm__"], 5, 100, 60, 1, T0 - NS)
+    sup = SupervisedLimiter(
+        lim, retries=0, probe_interval_ms=1, sleep_fn=lambda s: None
+    )
+    keys = [f"cr:{i % 6}" for i in range(8)]
+    outcomes = []
+    arm_faults(injector)
+    if recorder is not None:
+        arm(recorder)
+    try:
+        now = T0
+        for step in range(12):
+            res = sup.rate_limit_batch(keys, 3, 10, 60, 1, now)
+            outcomes.append((
+                np.asarray(res.allowed, np.uint8).copy(),
+                np.asarray(res.status, np.uint8).copy(),
+            ))
+            if recorder is not None:
+                recorder.record_window(
+                    now, keys, [[3, 10, 60, 1]] * len(keys),
+                    res.allowed, res.status,
+                )
+            now += 10 * NS  # past the probe interval: recovery happens
+        assert sup.state == "ok", "lifecycle never recovered"
+        assert sup.degrade_count >= 1, "lifecycle never degraded"
+    finally:
+        disarm_faults()
+        disarm()
+    return outcomes
+
+
+def test_fault_schedule_replay_reproduces_chaos_run(tmp_path):
+    """Acceptance: a chaos run armed with THROTTLECRAB_FAULTS-style
+    injection and trace capture, replayed from its trace, reproduces
+    the identical per-window outcome vector and identical
+    fired-injection sequence."""
+    from throttlecrab_tpu.faults import FaultInjector, parse_spec
+
+    path = str(tmp_path / "chaos.tctr")
+    recorder = FlightRecorder(
+        mode="full", out_dir=str(tmp_path), path=path,
+        dump_on_degrade=False,
+    )
+    live = FaultInjector(parse_spec("launch:count:2"), seed=11)
+    live_out = _supervised_chaos_run(live, recorder)
+    recorder.close()
+    live_schedule = live.fired_schedule()
+    assert live_schedule, "the fault never fired: vacuous chaos run"
+
+    trace = Trace.load(path)
+    # The trace captured the exact firings and the lifecycle events.
+    assert trace.injection_schedule() == live_schedule
+    kinds = [e.kind for e in trace.events]
+    assert "degrade" in kinds and "repromote" in kinds
+
+    # Replay: schedule-armed injector, fresh supervised limiter.
+    replayed = injector_from_trace(trace)
+    replay_out = _supervised_chaos_run(replayed)
+    assert outcome_vector(replay_out) == outcome_vector(live_out), (
+        "fault replay drifted from the live chaos run"
+    )
+    assert replayed.fired_schedule() == live_schedule, (
+        "replayed firing sequence differs"
+    )
+
+
+def test_scheduled_injector_fires_exact_indexes():
+    from throttlecrab_tpu.faults import FaultInjector, InjectedDeviceError
+
+    inj = FaultInjector.from_schedule(
+        [("launch", "count", 1, 0.0), ("launch", "transient", 3, 0.5)]
+    )
+    inj.check("launch")  # index 0: passes
+    with pytest.raises(InjectedDeviceError):
+        inj.check("launch")  # index 1: fires
+    inj.check("launch")  # index 2: passes
+    with pytest.raises(InjectedDeviceError):
+        inj.check("launch")  # index 3: fires
+    inj.check("launch")  # index 4: passes
+    inj.check("fetch")   # unscheduled site: passes
+    assert [i[2] for i in inj.fired_schedule()] == [1, 3]
+
+
+# ------------------------------------------------- dump-on-degrade #
+
+
+def test_supervisor_degrade_dumps_flight_recorder(tmp_path):
+    from throttlecrab_tpu.faults import FaultInjector
+    from throttlecrab_tpu.faults import arm as arm_faults
+    from throttlecrab_tpu.faults import disarm as disarm_faults
+    from throttlecrab_tpu.faults import parse_spec
+    from throttlecrab_tpu.server.supervisor import SupervisedLimiter
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    rec = FlightRecorder(capacity=64, out_dir=str(tmp_path))
+    arm(rec)
+    lim = TpuRateLimiter(capacity=256)
+    lim.rate_limit_batch(["__warm__"], 5, 100, 60, 1, T0 - NS)
+    sup = SupervisedLimiter(
+        lim, retries=0, probe_interval_ms=10_000,
+        sleep_fn=lambda s: None,
+    )
+    try:
+        arm_faults(FaultInjector(parse_spec("launch:count:1"), seed=1))
+        res = sup.rate_limit_batch(["k"], 5, 100, 60, 1, T0)
+        assert res.allowed[0]  # host oracle served it
+        assert sup.state == "degraded"
+        # The dump rides a daemon thread; wait for the artifact.
+        deadline = time.monotonic() + 10
+        dumped = []
+        while time.monotonic() < deadline and not dumped:
+            dumped = glob.glob(os.path.join(str(tmp_path), "*.tctr"))
+            time.sleep(0.05)
+        assert dumped, "degrade produced no trace dump"
+        trace = Trace.load(dumped[0])
+        assert any(e.kind == "degrade" for e in trace.events)
+        # The injection that killed the device is in the artifact too.
+        assert trace.injections and trace.injections[0].site == "launch"
+    finally:
+        disarm_faults()
+        disarm()
+
+
+# ------------------------------------------------ /trace/dump route #
+
+
+def test_http_trace_dump_route(tmp_path):
+    from throttlecrab_tpu.server.engine import BatchingEngine
+    from throttlecrab_tpu.server.http import HttpTransport
+    from throttlecrab_tpu.server.metrics import Metrics
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    async def run():
+        engine = BatchingEngine(
+            TpuRateLimiter(capacity=256), batch_size=8,
+            max_linger_us=100, now_fn=lambda: T0,
+        )
+        transport = HttpTransport("127.0.0.1", 0, engine, Metrics())
+        # Disarmed: the route answers enabled:false, no 404 probing.
+        status, payload, ctype = await transport._route(
+            "GET", "/trace/dump", b""
+        )
+        assert status == 200 and b'"enabled": false' in payload
+
+        rec = FlightRecorder(capacity=16, out_dir=str(tmp_path))
+        arm(rec)
+        rec.record_window(T0, [b"k"], [[5, 100, 60, 1]], [1], [0])
+        status, payload, _ = await transport._route(
+            "GET", "/trace/dump", b""
+        )
+        assert status == 200
+        import json
+
+        doc = json.loads(payload)
+        assert doc["enabled"] and doc["windows"] == 1
+        assert Trace.load(doc["path"]).n_rows() == 1
+        await engine.shutdown()
+
+    try:
+        asyncio.run(run())
+    finally:
+        disarm()
+
+
+# -------------------------------------------- fault-fired metrics #
+
+
+def test_faults_injected_total_metric():
+    from throttlecrab_tpu.faults import FaultInjector
+    from throttlecrab_tpu.faults import arm as arm_faults
+    from throttlecrab_tpu.faults import disarm as disarm_faults
+    from throttlecrab_tpu.faults import parse_spec
+    from throttlecrab_tpu.server.metrics import METRIC_NAMES, Metrics
+
+    assert "throttlecrab_tpu_faults_injected_total" in METRIC_NAMES
+    m = Metrics()
+    # Disarmed: the name still exports (dashboards need the series).
+    assert "throttlecrab_tpu_faults_injected_total 0" in (
+        m.export_prometheus()
+    )
+    inj = FaultInjector(parse_spec("keymap:count:2"), seed=3)
+    arm_faults(inj)
+    try:
+        for _ in range(3):
+            try:
+                inj.check("keymap")
+            except Exception:
+                pass
+        text = m.export_prometheus()
+        assert (
+            'throttlecrab_tpu_faults_injected_total{site="keymap"} 2'
+            in text
+        ), text
+    finally:
+        disarm_faults()
+
+
+# ------------------------------------------------- harness surface #
+
+
+def test_loadgen_summary_surfaces_seed_and_pattern():
+    from throttlecrab_tpu.harness.loadgen import PerfResult
+
+    r = PerfResult(
+        "http", 10, 1.0, 5, 5, 0, seed=42, key_pattern="flash-crowd"
+    )
+    s = r.summary()
+    assert s["seed"] == 42 and s["key_pattern"] == "flash-crowd"
+
+
+def test_harness_trace_roundtrip(tmp_path):
+    """_write_harness_trace output loads and drives a replay schedule
+    (the --record -> --replay loop, minus live sockets)."""
+    from throttlecrab_tpu.harness.loadgen import _write_harness_trace
+
+    rows = [
+        ("k:1", 5, 100, 60, 1, True, T0),
+        ("k:2", 5, 100, 60, 2, False, T0 + 1),
+        ("k:3", 5, 100, 60, 1, None, T0 + 2),  # transport error
+    ]
+    path = str(tmp_path / "h.tctr")
+    _write_harness_trace(path, [rows])
+    trace = Trace.load(path)
+    w = trace.windows[0]
+    assert w.keys == [b"k:1", b"k:2", b"k:3"]
+    assert w.allowed.tolist() == [1, 0, 0]
+    assert w.status.tolist() == [0, 0, 3]
+    np.testing.assert_array_equal(w.params[:, 0], [5, 5, 5])
+    # The per-row quantity column survives the record -> replay loop
+    # (replay schedules honor it; clients send it on every transport).
+    np.testing.assert_array_equal(w.params[:, 3], [1, 2, 1])
+
+
+def test_loadgen_seed_offsets_key_streams():
+    from throttlecrab_tpu.harness.workload import make_keys
+
+    a = make_keys("random", 50, 1000, seed=7)
+    b = make_keys("random", 50, 1000, seed=7)
+    c = make_keys("random", 50, 1000, seed=8)
+    assert a == b and a != c

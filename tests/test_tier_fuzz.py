@@ -48,3 +48,13 @@ def test_hotkey_abuse_deny_cache_slice():
     hits = fuzz.run_hotkey_deny_seed(4000, steps=24)
     assert fuzz.TOTAL["requests"] > before
     assert hits > 0
+
+
+@pytest.mark.parametrize("seed", [6000, 6001])
+def test_trace_codec_fuzz_slice(seed):
+    """Always-on slice of the record/replay trace-codec mutation fuzz
+    (truncation, corruption, count-vs-size lies): every rejection must
+    be the typed TraceError — the full campaign lives in
+    scripts/fuzz_wire_tiers.py alongside the cluster-codec fuzzer."""
+    n = fuzz.run_trace_frame_fuzz(seed, iters=250)
+    assert n == 250

@@ -145,32 +145,46 @@ class _Armed:
         ) & 0xFFFFFFFFFFFFFFFF
         return (self._state >> 11) / float(1 << 53)
 
-    def fire(self, sleep_fn) -> None:
-        """Raise (or stall) according to the mode, or pass through."""
+    def fire(self, sleep_fn, index: int, note_fired) -> None:
+        """Raise (or stall) according to the mode, or pass through.
+        `index` is the site's check counter (the draw index) and
+        `note_fired` logs every firing — the (site, mode, index, arg)
+        row a replay needs to reproduce this exact injection."""
         if self.healed:
             return
         spec = self.spec
         if spec.mode == "transient":
             if self._draw() < spec.arg:
                 self.fired += 1
+                note_fired(spec.site, spec.mode, index, spec.arg)
                 raise _site_error(spec.site, f"transient p={spec.arg}")
         elif spec.mode == "persistent":
             self.fired += 1
+            note_fired(spec.site, spec.mode, index, spec.arg)
             raise _site_error(spec.site, "persistent")
         elif spec.mode == "count":
             if self.remaining > 0:
                 self.remaining -= 1
                 self.fired += 1
+                note_fired(spec.site, spec.mode, index, spec.arg)
                 raise _site_error(
                     spec.site, f"count, {self.remaining} left"
                 )
         elif spec.mode == "hang":
             self.fired += 1
+            note_fired(spec.site, spec.mode, index, spec.arg)
             sleep_fn(spec.arg)
 
 
 class FaultInjector:
-    """An armed set of fault specs, checked at the injection points."""
+    """An armed set of fault specs, checked at the injection points.
+
+    Every firing is accounted twice over: ``fired_schedule()`` returns
+    the exact (site, mode, draw-index, arg) sequence — what
+    ``from_schedule`` replays bit-identically — and each firing is also
+    pushed to the flight recorder (replay/recorder.py) when one is
+    armed, so a captured chaos trace carries its own fault schedule.
+    """
 
     def __init__(
         self,
@@ -183,10 +197,20 @@ class FaultInjector:
         self._sleep = sleep_fn or time.sleep
         self._lock = threading.Lock()
         self._by_site: Dict[str, List[_Armed]] = {}
+        #: Per-site check counter: the draw index a replay keys on.
+        self._checks: Dict[str, int] = {}
+        #: Every firing, in order: (site, mode, index, arg).
+        self.fired_log: List[tuple] = []
         for spec in specs:
             self._by_site.setdefault(spec.site, []).append(
                 _Armed(spec, seed)
             )
+
+    def _note_fired(self, site, mode, index, arg) -> None:
+        self.fired_log.append((site, mode, index, arg))
+        from ..replay.recorder import maybe_record_injection
+
+        maybe_record_injection(site, mode, index, arg)
 
     def check(self, site: str) -> None:
         """Called from a hook; raises/stalls when a fault fires."""
@@ -194,8 +218,10 @@ class FaultInjector:
         if not armed:
             return
         with self._lock:
+            index = self._checks.get(site, 0)
+            self._checks[site] = index + 1
             for f in armed:
-                f.fire(self._sleep)
+                f.fire(self._sleep, index, self._note_fired)
 
     def heal(self, site: Optional[str] = None) -> None:
         """Disarm `site`'s faults (all sites when None) — models the
@@ -207,12 +233,58 @@ class FaultInjector:
                         f.healed = True
 
     def stats(self) -> Dict[str, int]:
-        """{site: total faults fired} for assertions and logs."""
+        """{site: total faults fired} for assertions and logs — also
+        exported as the per-site throttlecrab_tpu_faults_injected_total
+        counter (server/metrics.py)."""
         with self._lock:
             return {
                 s: sum(f.fired for f in armed)
                 for s, armed in self._by_site.items()
             }
+
+    def fired_schedule(self) -> List[tuple]:
+        """The exact firing sequence: (site, mode, index, arg) rows."""
+        with self._lock:
+            return list(self.fired_log)
+
+    @classmethod
+    def from_schedule(cls, entries, sleep_fn=None) -> "FaultInjector":
+        """Deterministic fault replay: an injector that fires exactly
+        the recorded (site, mode, index, arg) rows — at the same check
+        indexes, with the same error shapes — regardless of probability
+        draws.  A chaos run replays bit-identically, not merely
+        statistically.  A check index maps to a LIST of firings: one
+        live check can fire several armed specs (e.g. a hang that
+        stalls, then a transient that raises), and replay must
+        reproduce all of them in order."""
+        inj = cls((), sleep_fn=sleep_fn)
+        inj._schedule = {}
+        for site, mode, index, arg in entries:
+            inj._schedule.setdefault(site, {}).setdefault(
+                int(index), []
+            ).append((mode, float(arg)))
+        inj.check = inj._check_scheduled  # type: ignore[method-assign]
+        return inj
+
+    def _check_scheduled(self, site: str) -> None:
+        with self._lock:
+            index = self._checks.get(site, 0)
+            self._checks[site] = index + 1
+            hits = self._schedule.get(site, {}).get(index)
+            if not hits:
+                return
+            for mode, arg in hits:
+                self._note_fired(site, mode, index, arg)
+        # Recorded order == live armed order: hangs stalled first, and
+        # the firing that raised ended the live check — replay the
+        # stalls, then re-raise the (single possible) raising mode.
+        for mode, arg in hits:
+            if mode == "hang":
+                self._sleep(arg)
+            else:
+                raise _site_error(
+                    site, f"replayed {mode} (draw {index})"
+                )
 
 
 # ------------------------------------------------------------------ #

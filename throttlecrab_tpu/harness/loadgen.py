@@ -100,6 +100,11 @@ class PerfResult:
     denied: int
     errors: int
     latencies_s: List[float] = field(default_factory=list, repr=False)
+    # The deterministic base seed and key pattern that produced this
+    # run's key streams (worker w draws with seed + w): any failing
+    # harness run can be re-captured bit-identically from these two.
+    seed: int = 0
+    key_pattern: str = "random"
     # Chaos-run resilience tracking (--chaos): how the client
     # experienced injected server-side faults.
     max_consecutive_errors: int = 0
@@ -193,6 +198,8 @@ class PerfResult:
             "allowed": self.allowed,
             "denied": self.denied,
             "errors": self.errors,
+            "seed": self.seed,
+            "key_pattern": self.key_pattern,
             "p50_ms": round(self.percentile_ms(0.50), 3),
             "p90_ms": round(self.percentile_ms(0.90), 3),
             "p99_ms": round(self.percentile_ms(0.99), 3),
@@ -238,13 +245,17 @@ class HttpClient:
             self.host, self.port
         )
 
-    async def throttle(self, key: str, burst: int, count: int, period: int):
+    async def throttle(
+        self, key: str, burst: int, count: int, period: int,
+        quantity: int = 1,
+    ):
         body = json.dumps(
             {
                 "key": key,
                 "max_burst": burst,
                 "count_per_period": count,
                 "period": period,
+                "quantity": quantity,
             }
         ).encode()
         self.writer.write(
@@ -288,9 +299,12 @@ class RedisClient:
         )
 
     @staticmethod
-    def _frame(key: str, burst: int, count: int, period: int) -> bytes:
+    def _frame(
+        key: str, burst: int, count: int, period: int, quantity: int = 1
+    ) -> bytes:
         parts = [b"THROTTLE", key.encode(), str(burst).encode(),
-                 str(count).encode(), str(period).encode()]
+                 str(count).encode(), str(period).encode(),
+                 str(quantity).encode()]
         return b"*%d\r\n" % len(parts) + b"".join(
             b"$%d\r\n%s\r\n" % (len(p), p) for p in parts
         )
@@ -317,8 +331,11 @@ class RedisClient:
             return vals[0] == b":1"
         return None
 
-    async def throttle(self, key: str, burst: int, count: int, period: int):
-        self.writer.write(self._frame(key, burst, count, period))
+    async def throttle(
+        self, key: str, burst: int, count: int, period: int,
+        quantity: int = 1,
+    ):
+        self.writer.write(self._frame(key, burst, count, period, quantity))
         await self.writer.drain()
         return await self._read_response()
 
@@ -405,11 +422,14 @@ class GrpcClient:
             response_deserializer=pb.ThrottleResponse.FromString,
         )
 
-    async def throttle(self, key: str, burst: int, count: int, period: int):
+    async def throttle(
+        self, key: str, burst: int, count: int, period: int,
+        quantity: int = 1,
+    ):
         response = await self.method(
             self._pb.ThrottleRequest(
                 key=key, max_burst=burst, count_per_period=count,
-                period=period, quantity=1,
+                period=period, quantity=quantity,
             )
         )
         return response.allowed
@@ -441,6 +461,9 @@ async def run_perf_test(
     pipeline: int = 1,
     chaos: bool = False,
     stats_port: int = 0,
+    seed: int = 0,
+    record_path: str = "",
+    replay_path: str = "",
 ) -> PerfResult:
     """Barrier-synchronized workers, pre-generated keys
     (perf_test_multi_transport.rs:48-127).
@@ -451,9 +474,46 @@ async def run_perf_test(
 
     `stats_port` > 0 polls GET /stats (the insight tier) every 200 ms
     during the run and, with the flash-crowd key pattern, reports the
-    hot-key detection latency in result.stats_probe."""
+    hot-key detection latency in result.stats_probe.
+
+    `seed` offsets every worker's deterministic key stream (worker w
+    draws with seed + w), so a failing run re-captures bit-identically.
+    `record_path` writes the run's request schedule + observed outcomes
+    as a replayable trace (throttlecrab_tpu/replay); `replay_path`
+    drives the run from a trace's windows (round-robin across workers,
+    per-row params honored) instead of generating keys."""
     if pipeline > 1 and transport != "redis":
         raise ValueError("--pipeline requires the redis transport")
+    if pipeline > 1 and (record_path or replay_path):
+        raise ValueError("--record/--replay require --pipeline 1")
+
+    # Per-worker schedules of (key, burst, count, period, quantity).
+    if replay_path:
+        from ..replay.trace import Trace
+
+        trace = Trace.load(replay_path)
+        schedules: List[list] = [[] for _ in range(workers)]
+        for i, win in enumerate(trace.windows):
+            rows = schedules[i % workers]
+            for j in range(len(win)):
+                rows.append((
+                    win.keys[j].decode("utf-8", "surrogateescape"),
+                    int(win.params[j, 0]), int(win.params[j, 1]),
+                    int(win.params[j, 2]), int(win.params[j, 3]),
+                ))
+    else:
+        schedules = [
+            [
+                (k, burst, count, period, 1)
+                for k in make_keys(
+                    key_pattern, requests_per_worker, key_space,
+                    seed=seed + w,
+                )
+            ]
+            for w in range(workers)
+        ]
+    recorded: List[list] = [[] for _ in range(workers)]
+
     clients = [CLIENTS[transport](host, port) for _ in range(workers)]
     await asyncio.gather(*(c.connect() for c in clients))
 
@@ -469,12 +529,10 @@ async def run_perf_test(
         )
     shift = requests_per_worker // 2
 
-    all_keys = [
-        make_keys(key_pattern, requests_per_worker, key_space, seed=w)
-        for w in range(workers)
-    ]
     barrier = _make_barrier(workers)
-    result = PerfResult(transport, 0, 0.0, 0, 0, 0)
+    result = PerfResult(
+        transport, 0, 0.0, 0, 0, 0, seed=seed, key_pattern=key_pattern
+    )
     # Tenant-prefixed patterns report per-tenant splits (the isolation
     # scenario the sharded mesh's namespace layer serves).
     track_tenants = key_pattern == "noisy-neighbor"
@@ -502,10 +560,12 @@ async def run_perf_test(
 
     async def worker(w: int) -> None:
         client = clients[w]
-        keys = all_keys[w]
-        wl = Workload(workload, target_rps, requests_per_worker)
+        schedule = schedules[w]
+        record = recorded[w] if record_path else None
+        wl = Workload(workload, target_rps, len(schedule))
         await barrier.wait()
         if pipeline > 1:
+            keys = [row[0] for row in schedule]
             for start in range(0, len(keys), pipeline):
                 window = keys[start : start + pipeline]
                 if (
@@ -532,16 +592,22 @@ async def run_perf_test(
                 for key, allowed in zip(window, outcomes):
                     tally(allowed, key)
             return
-        for done, (key, delay) in enumerate(zip(keys, wl.delays())):
+        for done, ((key, kb, kc, kp, kq), delay) in enumerate(
+            zip(schedule, wl.delays())
+        ):
             if probe is not None and done == shift and probe.shift_t < 0:
                 probe.shift_t = time.perf_counter()
             if delay > 0:
                 await asyncio.sleep(delay)
             t0 = time.perf_counter()
             try:
-                allowed = await client.throttle(key, burst, count, period)
+                allowed = await client.throttle(key, kb, kc, kp, kq)
             except Exception:
                 tally_errors(1)
+                if record is not None:
+                    record.append(
+                        (key, kb, kc, kp, kq, None, time.time_ns())
+                    )
                 # The stream may hold a half-read response; a reconnect is
                 # the only way to resynchronize the framing.  Abort the
                 # worker if the server is truly gone.
@@ -549,16 +615,22 @@ async def run_perf_test(
                     await client.close()
                     await client.connect()
                 except Exception:
-                    tally_errors(len(keys) - done - 1)
+                    tally_errors(len(schedule) - done - 1)
                     return
                 continue
             result.latencies_s.append(time.perf_counter() - t0)
+            if record is not None:
+                record.append(
+                    (key, kb, kc, kp, kq, allowed, time.time_ns())
+                )
             tally(allowed, key)
 
     t_start = time.perf_counter()
     await asyncio.gather(*(worker(w) for w in range(workers)))
     result.elapsed_s = time.perf_counter() - t_start
-    result.total_requests = workers * requests_per_worker
+    result.total_requests = sum(len(s) for s in schedules)
+    if record_path:
+        _write_harness_trace(record_path, recorded)
     if stats_task is not None:
         # Give the poller one more cadence to catch a shift that
         # happened in the run's final windows, then stop it.
@@ -568,6 +640,28 @@ async def run_perf_test(
         result.stats_probe = probe
     await asyncio.gather(*(c.close() for c in clients))
     return result
+
+
+def _write_harness_trace(path: str, recorded) -> None:
+    """Client-side capture: each worker's (key, params, outcome, t_ns)
+    rows become trace windows (<= 512 rows each, worker-ordered), so a
+    live run replays through `--replay` or the offline player."""
+    from ..replay.trace import SOURCE_HARNESS, TraceWriter
+
+    writer = TraceWriter()
+    for rows in recorded:
+        for start in range(0, len(rows), 512):
+            chunk = rows[start : start + 512]
+            writer.add_window(
+                chunk[0][6],
+                SOURCE_HARNESS,
+                [r[0].encode("utf-8", "surrogateescape") for r in chunk],
+                [[r[1], r[2], r[3], r[4]] for r in chunk],
+                [1 if r[5] else 0 for r in chunk],
+                # Outcome status: 0 decided, 3 (internal) transport error.
+                [0 if r[5] is not None else 3 for r in chunk],
+            )
+    writer.save(path)
 
 
 def main(argv=None) -> int:
@@ -604,6 +698,20 @@ def main(argv=None) -> int:
                         "resilience stats (error rate, longest error "
                         "run, recovery) alongside the latency summary")
     p.add_argument("--key-space", type=int, default=10_000)
+    p.add_argument("--seed", type=int, default=0,
+                   help="base seed for the deterministic per-worker key "
+                        "streams (worker w draws with seed + w; the "
+                        "summary echoes it so any run re-captures "
+                        "bit-identically)")
+    p.add_argument("--record", default="", metavar="TRACE",
+                   help="write the run's request schedule + observed "
+                        "outcomes as a replayable trace file "
+                        "(throttlecrab_tpu/replay format)")
+    p.add_argument("--replay", default="", metavar="TRACE",
+                   help="drive the run from a trace file (recorded or "
+                        "synthesized via python -m "
+                        "throttlecrab_tpu.replay synth) instead of "
+                        "generating keys; per-row params are honored")
     p.add_argument("--workload", default="steady",
                    choices=["steady", "burst", "ramp", "wave"])
     p.add_argument("--target-rps", type=float, default=0.0,
@@ -633,6 +741,14 @@ def main(argv=None) -> int:
     if args.stats and args.procs > 1:
         print("error: --stats requires --procs 1", file=sys.stderr)
         return 2
+    if (args.record or args.replay) and (
+        args.procs > 1 or args.pipeline > 1
+    ):
+        print(
+            "error: --record/--replay require --procs 1 --pipeline 1",
+            file=sys.stderr,
+        )
+        return 2
     for transport in transports:
         key_pattern = args.key_pattern
         if args.chaos and key_pattern == "random":
@@ -645,6 +761,8 @@ def main(argv=None) -> int:
             workload=args.workload, target_rps=args.target_rps,
             pipeline=args.pipeline, chaos=args.chaos,
             stats_port=(args.stats_port or args.port) if args.stats else 0,
+            seed=args.seed, record_path=args.record,
+            replay_path=args.replay,
         )
         if args.procs > 1:
             result = run_multiproc(
@@ -707,11 +825,21 @@ def run_multiproc(
         parts = pool.starmap(
             _proc_entry,
             [
-                (transport, host, port, per_proc, requests, kwargs)
-                for _ in range(procs)
+                (
+                    transport, host, port, per_proc, requests,
+                    # Offset each process's seed block so worker
+                    # streams stay distinct across the whole fan-out
+                    # (proc i's workers draw seed + i*per_proc + w).
+                    {**kwargs, "seed": kwargs.get("seed", 0) + i * per_proc},
+                )
+                for i in range(procs)
             ],
         )
-    merged = PerfResult(transport, 0, 0.0, 0, 0, 0)
+    merged = PerfResult(
+        transport, 0, 0.0, 0, 0, 0,
+        seed=kwargs.get("seed", 0),
+        key_pattern=kwargs.get("key_pattern", "random"),
+    )
     for (total, elapsed, allowed, denied, errors, lats,
          max_consec, first_err, last_rec) in parts:
         merged.total_requests += total

@@ -642,6 +642,13 @@ class ClusterLimiter(ScalarCompatMixin):
         #: transition — reduce or restore — and a restart whose peers
         #: still hold our old degraded weight).
         self._reweight_heal_until = 0.0
+        #: Flight-recorder capture of client-visible decisions at THIS
+        #: frontend (replay/).  Off by default: when an engine drives
+        #: this limiter the engine's own per-batch hook records, and a
+        #: second hook here would double-capture every window.  Library
+        #: users (the in-process chaos/replay harnesses) set it to True
+        #: to capture at the cluster frontend instead.
+        self.capture = False
         self._pump = None
         if self.ring is not None and len(self.nodes) > 1:
             self._pump = _ClusterPump(self)
@@ -1075,6 +1082,26 @@ class ClusterLimiter(ScalarCompatMixin):
             status[bad] = STATUS_INVALID_PARAMS
             allowed[bad] = False
 
+        if self.capture and _hops == 0:
+            # Per-batch capture at the cluster frontend (opt-in; see
+            # __init__): the client-visible outcome vector, tagged with
+            # this node's index so a replayer routes each window through
+            # the frontend that originally decided it.  Forwarded
+            # batches re-enter here on the OWNER with _hops >= 1 —
+            # capturing those too would record every forwarded request
+            # twice and double-count it on replay.
+            from ..replay.recorder import active_recorder
+            from ..replay.trace import SOURCE_CLUSTER_BASE
+
+            recorder = active_recorder()
+            if recorder is not None:
+                recorder.record_window(
+                    now_ns, kb,
+                    np.stack([mb, cp, pd, qt], axis=1),
+                    allowed, status,
+                    source=SOURCE_CLUSTER_BASE + self.self_index,
+                )
+
         if wire:
             return WireBatchResult(
                 allowed=allowed, limit=limit, remaining=remaining,
@@ -1288,6 +1315,9 @@ class ClusterLimiter(ScalarCompatMixin):
             "cluster join announced by %s: migrating its key range "
             "back", self.nodes[origin],
         )
+        from ..replay.recorder import maybe_record_event
+
+        maybe_record_event("cluster-join", str(origin))
         import contextlib
 
         peer = self.peers[origin]
@@ -1436,6 +1466,9 @@ class ClusterLimiter(ScalarCompatMixin):
             "adopted cluster ring epoch %d (weights %s)", epoch,
             [round(w, 3) for w in weights],
         )
+        from ..replay.recorder import maybe_record_event
+
+        maybe_record_event("cluster-epoch", str(epoch))
 
     def _ensure_takeover(self, dead: int) -> None:
         """First failover onto a dead peer's range: absorb its warm
@@ -1479,6 +1512,9 @@ class ClusterLimiter(ScalarCompatMixin):
                 "peer %s declared dead: took over its range from %d "
                 "warm-replica rows", self.nodes[dead], len(take_k),
             )
+            from ..replay.recorder import maybe_record_event
+
+            maybe_record_event("cluster-takeover", str(dead))
 
     def _replicating(self) -> bool:
         return self.replicate and self._pump is not None
@@ -1753,6 +1789,11 @@ class ClusterLimiter(ScalarCompatMixin):
         log.warning(
             "announcing cluster weight %.2f for %s",
             weight, self.nodes[self.self_index],
+        )
+        from ..replay.recorder import maybe_record_event
+
+        maybe_record_event(
+            "cluster-reweight", f"{self.self_index}:{weight}"
         )
         with self.device_lock:
             # Epoch bump, export and the ring flip are one atomic step

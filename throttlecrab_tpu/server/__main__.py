@@ -181,6 +181,20 @@ async def run_server(config: Config) -> None:
         arm(FaultInjector(parse_spec(config.faults),
                           seed=config.faults_seed))
         log.warning("fault injection armed: %s", config.faults)
+    recorder = None
+    if config.trace_dir:
+        # Flight recorder (throttlecrab_tpu/replay/): per-batch capture
+        # hooks on the engine flush path, the native driver and the
+        # supervisor's degrade path all feed this one process-wide
+        # recorder; GET /trace/dump and persistent degrade dump it.
+        from ..replay import recorder as replay_recorder
+
+        recorder = replay_recorder.from_config(config)
+        replay_recorder.arm(recorder)
+        log.info(
+            "trace recorder armed: dir=%s mode=%s windows=%d",
+            config.trace_dir, config.trace_mode, config.trace_windows,
+        )
     device_limiter = create_limiter(config)
     if getattr(device_limiter, "tenants", None) is not None:
         # Sharded mesh with the tenant layer armed: export the
@@ -326,6 +340,14 @@ async def run_server(config: Config) -> None:
     log.info("shutting down")
     stop_task.cancel()
     await engine.shutdown()
+    if recorder is not None:
+        # Finalize the trace: full mode flushes + closes its incremental
+        # file so a recorded workload replays after a clean stop (ring
+        # mode persists nothing unless dumped — by design).
+        from ..replay import recorder as replay_recorder
+
+        await loop.run_in_executor(None, recorder.close)
+        replay_recorder.disarm()
     if cluster_nodes:
         # Stop the replica/membership pump and drop peer sockets before
         # the snapshot, so no migration mutates the table under it.

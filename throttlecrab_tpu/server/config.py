@@ -134,6 +134,25 @@ _SPEC = [
      "count:n, hang:seconds (empty: off; see throttlecrab_tpu/faults/)"),
     ("faults_seed", "THROTTLECRAB_FAULTS_SEED", 0, int,
      "Seed for the deterministic fault-injection probability stream"),
+    # --- record/replay flight recorder (throttlecrab_tpu/replay/) ------
+    ("trace_dir", "THROTTLECRAB_TRACE_DIR", "", str,
+     "Arm the decision-trace flight recorder and write trace dumps "
+     "into this directory (empty: off).  Dumps happen on persistent "
+     "degrade, on GET /trace/dump, and at shutdown in full mode; "
+     "replay them with python -m throttlecrab_tpu.replay"),
+    ("trace_windows", "THROTTLECRAB_TRACE_WINDOWS", 1024, int,
+     "Ring mode: how many decided windows the flight recorder retains "
+     "(the last-N post-mortem buffer)"),
+    ("trace_mode", "THROTTLECRAB_TRACE_MODE", "ring", str,
+     "ring (bounded last-N flight recorder, serving-safe default) or "
+     "full (record every window incrementally to the trace file — the "
+     "capture-for-replay mode)"),
+    ("trace_dump_on_degrade", "THROTTLECRAB_TRACE_DUMP_ON_DEGRADE",
+     True, bool,
+     "Automatically dump the flight recorder when the supervisor "
+     "declares the device down (persistent degrade), so every chaos "
+     "failure leaves a replayable post-mortem artifact (env 0 "
+     "disables)"),
     ("cluster_nodes", "THROTTLECRAB_CLUSTER_NODES", "", str,
      "Comma-separated host:port cluster RPC addresses of every node "
      "(same list on every node; empty: single-node)"),
@@ -249,6 +268,10 @@ class Config:
     supervisor_mode: str = "degrade"
     faults: str = ""
     faults_seed: int = 0
+    trace_dir: str = ""
+    trace_windows: int = 1024
+    trace_mode: str = "ring"
+    trace_dump_on_degrade: bool = True
     cluster_nodes: str = ""
     cluster_index: int = 0
     cluster_bind_host: str = "0.0.0.0"
@@ -385,6 +408,13 @@ class Config:
                 parse_spec(self.faults)
             except ValueError as e:
                 raise ConfigError(f"invalid --faults spec: {e}") from e
+        if self.trace_mode not in ("ring", "full"):
+            raise ConfigError(
+                f"Invalid trace mode: {self.trace_mode!r} "
+                "(expected ring or full)"
+            )
+        if self.trace_windows <= 0:
+            raise ConfigError("trace_windows must be > 0")
         if self.cluster_vnodes < 0:
             raise ConfigError(
                 "cluster_vnodes must be >= 0 (0 = legacy modulo routing)"

@@ -323,6 +323,41 @@ class BatchingEngine:
                 if not fut.done():
                     fut.set_exception(ThrottleError(str(exc)))
 
+    def _record_windows(self, windows, results, now_ns) -> None:
+        """Flight-recorder capture (replay/): one call per decided
+        window — runs on the executor, off the event loop."""
+        from ..replay.recorder import active_recorder
+        from ..replay.trace import SOURCE_ENGINE
+
+        rec = active_recorder()
+        if rec is None:
+            return
+        for window, result in zip(windows, results):
+            rec.record_window(
+                now_ns,
+                [r.key for r, _ in window],
+                [
+                    (r.max_burst, r.count_per_period, r.period, r.quantity)
+                    for r, _ in window
+                ],
+                result.allowed,
+                result.status,
+                source=SOURCE_ENGINE,
+            )
+
+    async def _maybe_record(self, windows, results, now_ns) -> None:
+        """Per-batch capture hook (the fault hooks' one-None-check
+        discipline when disarmed; armed captures hop to the executor so
+        trace encoding never runs on the event loop)."""
+        from ..replay.recorder import active_recorder
+
+        if active_recorder() is None:
+            return
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self._record_windows, windows, results, now_ns
+        )
+
     def _observe_window(self, window, result, now_ns, seq) -> None:
         """Feed one decided window's rows to the front tier (in arrival
         order): allowed rows invalidate/refresh write records, denied
@@ -394,6 +429,7 @@ class BatchingEngine:
                 # Admission-only fronts skip the per-row observe loop:
                 # every call inside it would be a no-op.
                 self._observe_window(window, result, now_ns, seq)
+        await self._maybe_record(windows, results, now_ns)
         if self.front is not None:
             self.front.record_launch(total, elapsed)
         if self.metrics is not None:
@@ -444,6 +480,7 @@ class BatchingEngine:
                 # Admission-only fronts skip the per-row observe loop:
                 # every call inside it would be a no-op.
                 self._observe_window(window, result, now_ns, seq)
+        await self._maybe_record(windows, results, now_ns)
         if self.front is not None:
             self.front.record_launch(total, elapsed)
         if self.metrics is not None:
@@ -487,6 +524,7 @@ class BatchingEngine:
         self._complete(batch, result)
         if self.front is not None and self.front.deny_cache is not None:
             self._observe_window(batch, result, now_ns, seq)
+        await self._maybe_record([batch], [result], now_ns)
         await self._maybe_sweep(now_ns, len(batch))
 
     @staticmethod

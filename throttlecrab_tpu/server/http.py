@@ -158,6 +158,29 @@ class HttpTransport(ConnTrackingMixin):
                 view_fn() if view_fn is not None else {"mode": "none"}
             ).encode()
             return 200, payload, "application/json"
+        if method == "GET" and path == "/trace/dump":
+            # Admin: dump the flight recorder's retained windows to a
+            # trace file (throttlecrab_tpu/replay/).  Disarmed servers
+            # answer enabled:false so pollers need no probe logic; the
+            # dump itself (encode + file write) runs on the executor —
+            # never on the event loop.
+            from ..replay.recorder import active_recorder
+
+            recorder = active_recorder()
+            if recorder is None:
+                payload = json.dumps({"enabled": False}).encode()
+                return 200, payload, "application/json"
+            loop = asyncio.get_running_loop()
+            dump_path, n_windows = await loop.run_in_executor(
+                None, recorder.dump
+            )
+            payload = json.dumps({
+                "enabled": True,
+                "path": dump_path,
+                "windows": n_windows,
+                "stats": recorder.stats(),
+            }).encode()
+            return 200, payload, "application/json"
         if method == "GET" and path == "/metrics":
             return (
                 200,

@@ -51,6 +51,10 @@ METRIC_NAMES = (
     "throttlecrab_tpu_supervisor_retries",
     "throttlecrab_tpu_supervisor_degrades",
     "throttlecrab_tpu_supervisor_repromotes",
+    # Fault injection (faults/injector.py): chaos runs and soaks assert
+    # "the fault actually fired" from this per-site counter instead of
+    # inferring it from downstream symptoms.
+    "throttlecrab_tpu_faults_injected_total",
     "throttlecrab_cluster_forwarded_total",
     "throttlecrab_cluster_failed_total",
     # Elastic cluster (ring mode, parallel/cluster.py + parallel/ring.py).
@@ -473,6 +477,27 @@ class Metrics:
             "counter",
             self.supervisor_repromotes,
         )
+        # Fault injection (chaos runs): per-site fired counts from the
+        # armed injector, so a soak can assert the fault actually fired.
+        from ..faults import active_injector
+
+        out.append(
+            "# HELP throttlecrab_tpu_faults_injected_total Injected "
+            "faults fired, by site (0 lines when disarmed)"
+        )
+        out.append(
+            "# TYPE throttlecrab_tpu_faults_injected_total counter"
+        )
+        injector = active_injector()
+        fault_stats = injector.stats() if injector is not None else {}
+        if fault_stats:
+            for site, fired in sorted(fault_stats.items()):
+                out.append(
+                    "throttlecrab_tpu_faults_injected_total"
+                    f'{{site="{escape_label_value(site)}"}} {fired}'
+                )
+        else:
+            out.append("throttlecrab_tpu_faults_injected_total 0")
         # Insight tier (L3.75, insight/).
         ins = self._insight_stats() if self._insight_stats else {}
         metric(

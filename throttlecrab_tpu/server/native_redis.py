@@ -533,6 +533,7 @@ class NativeRedisTransport:
                     self._observe_plan(plan, res, now_ns, seq)
                 merged.append(self._merge_plan(plan, res))
             results = merged
+        self._maybe_record(batches, results, now_ns)
         # Metrics: ONE aggregated record for the whole window — it was
         # one device launch (record_batch bumps device_launches, so
         # per-sub-batch calls would overcount launches by up to
@@ -575,6 +576,34 @@ class NativeRedisTransport:
                 launches=1 if frames else 0,
             )
         self._maybe_sweep(now_ns, sum(len(b[1]) - 1 for b in batches))
+
+    def _maybe_record(self, batches, results, now_ns) -> None:
+        """Flight-recorder capture (replay/): the native twin of
+        engine._maybe_record — per-batch, already off any event loop
+        (this is the driver thread), one None check when disarmed."""
+        from ..replay.recorder import active_recorder
+        from ..replay.trace import SOURCE_NATIVE
+
+        rec = active_recorder()
+        if rec is None:
+            return
+        for (blob, offsets, params, _gen, _fd), res in zip(
+            batches, results
+        ):
+            n = len(offsets) - 1
+            keys = [
+                blob[offsets[i]: offsets[i + 1]] for i in range(n)
+            ]
+            if res is None:
+                allowed = np.zeros(n, np.uint8)
+                status = np.full(n, STATUS_INTERNAL, np.uint8)
+            else:
+                allowed = res.allowed
+                status = res.status
+            rec.record_window(
+                now_ns, keys, params.reshape(n, 4), allowed, status,
+                source=SOURCE_NATIVE,
+            )
 
     def _respond_one(
         self, blob, offsets, cookie_gen, cookie_fd, res, track_denied
