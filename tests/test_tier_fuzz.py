@@ -39,6 +39,36 @@ def test_tier_ladder_fuzz_slice(seed):
     assert fuzz.TOTAL["requests"] > before
 
 
+@pytest.mark.parametrize("seed", [3100, 3101])  # edges/hostile profiles
+def test_tier_ladder_fuzz_fused_alternation(seed, monkeypatch):
+    """The fused Pallas decision kernel alternated with the composed-XLA
+    path across consecutive windows of the tier-ladder corpus: both stay
+    pinned to the scalar oracle request-by-request, and each continues
+    exactly from the table state the other left (the kill-switch
+    stored-state compatibility contract).  Odd seeds arm the insight
+    tier on BOTH the single-device limiter and the mesh, covering the
+    fused kernel's 6-wide row template; even seeds pin the 4-wide one.
+    The hostile profile (3101) drives the degenerate three-view orbit
+    and the tier ladder's mid-stream downgrades through the fused path.
+    """
+    monkeypatch.setenv("THROTTLECRAB_PALLAS_FUSED", "0")
+    from conftest import require_devices
+
+    try:
+        require_devices(2)
+        from throttlecrab_tpu.parallel.sharded import make_mesh
+
+        mesh = make_mesh(2)
+    except Exception:
+        mesh = None
+    before = fuzz.TOTAL["requests"]
+    fuzz.run_seed(
+        seed, steps=6, sharded_mesh=mesh,
+        fused_alternate=True, insight_single=bool(seed % 2),
+    )
+    assert fuzz.TOTAL["requests"] > before
+
+
 def test_hotkey_abuse_deny_cache_slice():
     """One seed of the hot-key abuse profile (harness `hotkey-abuse`
     pattern) through the front tier's deny cache: cache-on and cache-off

@@ -18,6 +18,18 @@ log = logging.getLogger("throttlecrab.store")
 
 def create_limiter(config):
     """Build the device limiter the engine will drive."""
+    if hasattr(config, "pallas_fused"):
+        # The fused-kernel switch is read from the environment at every
+        # dispatch (kernel.pallas_fused_enabled); write the RESOLVED
+        # config value back in BOTH directions — config already folded
+        # CLI > env > default, and a one-way write would let a stale
+        # "1" from an earlier limiter in this process defeat a later
+        # config's kill switch.
+        import os
+
+        os.environ["THROTTLECRAB_PALLAS_FUSED"] = (
+            "1" if config.pallas_fused else "0"
+        )
     if config.shards > 1:
         from ..parallel.sharded import ShardedTpuRateLimiter, make_mesh
         from ..parallel.tenants import TenantRegistry

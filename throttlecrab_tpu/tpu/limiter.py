@@ -458,6 +458,11 @@ class _PendingWireLaunch:
 class TpuRateLimiter(ScalarCompatMixin):
     """Batched GCRA over a device bucket table + host keymap."""
 
+    # Batches are padded to a power of two of at least MIN_PAD lanes:
+    # few distinct jit-cache shapes as traffic varies, AND at least the
+    # Pallas kernels' DMA ring depth (pallas_fused.RING == 16 == the
+    # retired pallas_ops ring) so the fused path's pipelines never run
+    # shorter than their in-flight window.
     MIN_PAD = 16
 
     def __init__(

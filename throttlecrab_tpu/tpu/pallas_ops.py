@@ -1,7 +1,18 @@
-"""Pallas TPU kernels for the bucket-table row gather/scatter.
+"""LEGACY Pallas TPU kernels for the bucket-table row gather/scatter.
 
-STATUS: NO-GO on hardware — kept for the record and the interpret-mode
-tests.  The round-4 hardware evidence (docs/tpu-launch-profile.md):
+STATUS: the ROW-MOVEMENT-ONLY design here is retired (NO-GO on
+hardware — evidence below); it is NOT the fused decision kernel.
+These kernels moved rows for a decision body that still ran as
+composed XLA, and the on-device ablation showed row movement within
+noise *inside one fused XLA computation* — a verdict on row movement
+alone, not on fusion.  The successor, `pallas_fused.py`
+(THROTTLECRAB_PALLAS_FUSED=1), fuses the ENTIRE per-window decision —
+unpack, gather, closed forms in i32-pair arithmetic, pack, scatter —
+into one launch, attacking the inter-op HBM round trips and dispatch
+overhead this module's ablation never measured.  Do not read the
+history below as condemning that work.
+
+The round-4 hardware evidence (docs/tpu-launch-profile.md):
 
 1. The CPU ablation that motivated these kernels (~85% of kernel time in
    row movement) does NOT transfer to the TPU: the on-device ablation
@@ -19,7 +30,8 @@ tests.  The round-4 hardware evidence (docs/tpu-launch-profile.md):
 
 The design stands as documentation: a RING-deep window of per-row async
 DMAs for gather and (unique-index) scatter, i64 GCRA arithmetic left to
-XLA (TPU vector lanes are 32-bit).  Enable with THROTTLECRAB_PALLAS=1,
+XLA (TPU vector lanes are 32-bit; pallas_fused.py instead decomposes it
+into i32 hi/lo pairs).  Enable with THROTTLECRAB_PALLAS=1,
 set before the first kernel trace (each jit cache entry freezes the
 choice at trace time).  Off-TPU the kernels run in interpret mode —
 correct but orders of magnitude slower (the DMA ring is emulated); that

@@ -101,6 +101,21 @@ def tats_cur_safe(tats) -> bool:
     )
 
 
+def _fused_enabled() -> bool:
+    """Route decision windows through the fused Pallas kernel
+    (pallas_fused.py; THROTTLECRAB_PALLAS_FUSED=1).  Read per dispatch
+    — the fused wrappers and the composed-XLA twins are separate jit
+    entry points, so the flag flips between launches without retracing
+    tricks and unset preserves byte-identical current behavior.  The
+    check itself must not import pallas_fused: with the kill switch
+    engaged the default path stays isolated from the experimental
+    pallas stack (kernel.pallas_fused_enabled is the canonical parse).
+    """
+    from .kernel import pallas_fused_enabled
+
+    return pallas_fused_enabled()
+
+
 class StaleIdRowsError(RuntimeError):
     """Device-resident by-id parameter rows refer to slots the keymap has
     since remapped (sweep freed them or the table grew); re-run
@@ -218,9 +233,13 @@ class BucketTable(HwmMarksMixin):
         """Widen the state rows to kernel.INS_WIDTH (appending
         zero-initialized denied-hit counter columns), allocate the
         totals accumulator, and route decision launches through the
-        gcra_*_ins kernel twins.  Idempotent.  The Pallas row-movement
-        kernels only speak 4-wide rows, so an insight table always uses
-        the plain XLA gather/scatter regardless of THROTTLECRAB_PALLAS.
+        gcra_*_ins kernel twins.  Idempotent.  The LEGACY Pallas
+        row-movement kernels (THROTTLECRAB_PALLAS) only speak 4-wide
+        rows, so an insight table always uses the plain XLA
+        gather/scatter for them; the fused decision kernel
+        (THROTTLECRAB_PALLAS_FUSED) is width-polymorphic — its 6-wide
+        template folds the denied-hit counter into the same row DMAs,
+        so insight and the fused Pallas path coexist with no downgrade.
         """
         from .kernel import INS_WIDTH
 
@@ -228,18 +247,22 @@ class BucketTable(HwmMarksMixin):
             return
         from . import pallas_ops
 
-        if pallas_ops.enabled():
+        if pallas_ops.enabled() and not _fused_enabled():
             # Loud, not silent: a THROTTLECRAB_PALLAS=1 deployment that
-            # also enables insight loses its opted-in DMA row path —
-            # the operator should pick one (THROTTLECRAB_INSIGHT=0
-            # restores it).
+            # also enables insight loses its opted-in legacy DMA row
+            # path — the operator should pick one (THROTTLECRAB_
+            # INSIGHT=0 restores it, or THROTTLECRAB_PALLAS_FUSED=1
+            # moves to the width-polymorphic fused kernel).  The fused
+            # path carries INS_WIDTH rows natively: no warning there.
             import logging
 
             logging.getLogger("throttlecrab.table").warning(
-                "insight-widened rows disable the Pallas DMA row "
-                "kernels (THROTTLECRAB_PALLAS=1 requested); decision "
-                "launches use the plain XLA gather/scatter — set "
-                "THROTTLECRAB_INSIGHT=0 to keep the Pallas path"
+                "insight-widened rows disable the legacy Pallas DMA "
+                "row kernels (THROTTLECRAB_PALLAS=1 requested); "
+                "decision launches use the plain XLA gather/scatter — "
+                "set THROTTLECRAB_INSIGHT=0 to keep the legacy row "
+                "path, or THROTTLECRAB_PALLAS_FUSED=1 for the "
+                "width-polymorphic fused kernel"
             )
         ctx = (
             jax.default_device(self.device)
@@ -343,7 +366,24 @@ class BucketTable(HwmMarksMixin):
             jnp.asarray(valid, bool),
             now_ns,
         )
-        if self.insight:
+        if _fused_enabled():
+            from . import pallas_fused
+
+            if self.insight:
+                self.state, self.exp_acc, self.ins_counts, out = (
+                    pallas_fused.gcra_batch_fused_ins(
+                        self.state, self.exp_acc, self.ins_counts, *args,
+                        with_degen=with_degen, compact=compact,
+                    )
+                )
+            else:
+                self.state, self.exp_acc, out = (
+                    pallas_fused.gcra_batch_fused_acc(
+                        self.state, self.exp_acc, *args,
+                        with_degen=with_degen, compact=compact,
+                    )
+                )
+        elif self.insight:
             self.state, self.exp_acc, self.ins_counts, out = (
                 gcra_batch_ins(
                     self.state, self.exp_acc, self.ins_counts, *args,
@@ -387,7 +427,24 @@ class BucketTable(HwmMarksMixin):
             jnp.asarray(valid, bool),
             jnp.asarray(now_ns, jnp.int64),
         )
-        if self.insight:
+        if _fused_enabled():
+            from . import pallas_fused
+
+            if self.insight:
+                self.state, self.exp_acc, self.ins_counts, out = (
+                    pallas_fused.gcra_scan_fused_ins(
+                        self.state, self.exp_acc, self.ins_counts, *args,
+                        with_degen=with_degen, compact=compact,
+                    )
+                )
+            else:
+                self.state, self.exp_acc, out = (
+                    pallas_fused.gcra_scan_fused_acc(
+                        self.state, self.exp_acc, *args,
+                        with_degen=with_degen, compact=compact,
+                    )
+                )
+        elif self.insight:
             self.state, self.exp_acc, self.ins_counts, out = (
                 gcra_scan_ins(
                     self.state, self.exp_acc, self.ins_counts, *args,
@@ -437,7 +494,24 @@ class BucketTable(HwmMarksMixin):
             else jnp.asarray(packed, jnp.int32),
             jnp.asarray(now_ns, jnp.int64),
         )
-        if self.insight:
+        if _fused_enabled():
+            from . import pallas_fused
+
+            if self.insight:
+                self.state, self.exp_acc, self.ins_counts, out = (
+                    pallas_fused.gcra_scan_packed_fused_ins(
+                        self.state, self.exp_acc, self.ins_counts, *args,
+                        with_degen=with_degen, compact=compact,
+                    )
+                )
+            else:
+                self.state, self.exp_acc, out = (
+                    pallas_fused.gcra_scan_packed_fused_acc(
+                        self.state, self.exp_acc, *args,
+                        with_degen=with_degen, compact=compact,
+                    )
+                )
+        elif self.insight:
             self.state, self.exp_acc, self.ins_counts, out = (
                 gcra_scan_packed_ins(
                     self.state, self.exp_acc, self.ins_counts, *args,
