@@ -37,7 +37,9 @@ Flags: --cpu (force CPU backend), --quick (fewer batches), --depth K
 --segment {auto,device,host} (where the duplicate-segment structure is
 derived on the byid path), --no-resident (skip the kernel-ceiling
 measurement), --pallas (route row movement through the Pallas kernels —
-a documented NO-GO on this tunnel's remote compiler).
+a documented NO-GO on this tunnel's remote compiler), --control
+(control-plane A/B: kill-switch bit-identity, static defaults vs
+controller on the declared objective, rank x2 determinism).
 
 Hardening: the accelerator on this host is reached through a tunnel whose
 relay can wedge (a process killed mid-claim leaves every later device query
@@ -217,6 +219,15 @@ def main() -> int:
     ap.add_argument("--replay-trace", default="",
                     help="with --replay: replay this trace file "
                          "instead of synthesizing one")
+    ap.add_argument("--control", action="store_true",
+                    help="control-plane A/B instead (ISSUE 16): one "
+                         "flash-crowd trace simulated under virtual "
+                         "time against static defaults vs the feedback "
+                         "controller (throttlecrab_tpu/control), same "
+                         "session; verifies the controller-off run is "
+                         "bit-identical to a plain oracle replay first, "
+                         "then compares the declared multi-objective "
+                         "score and ranks the default candidate grid")
     args = ap.parse_args()
 
     if args.mesh:
@@ -267,6 +278,8 @@ def main() -> int:
         return run_cluster_bench(args)
     if args.replay:
         return run_replay_bench(args, device)
+    if args.control:
+        return run_control_bench(args, device)
     pallas_interpreted = args.pallas and device.platform != "tpu"
     if pallas_interpreted:
         print(
@@ -824,6 +837,103 @@ def run_replay_bench(args, device) -> int:
         )
     )
     return 0 if identical else 1
+
+
+def run_control_bench(args, device) -> int:
+    """Control-plane same-session A/B (ISSUE 16): one flash-crowd
+    trace — synthetic by default, or any recorded trace via
+    --replay-trace — simulated under virtual time (2x overload: the
+    virtual device drains half the offered rate) twice in this
+    session: once with static default knobs, once with the feedback
+    controller armed.
+
+    Order of proof mirrors run_replay_bench: FIRST the kill-switch
+    contract — the controller-off simulation's outcome planes must be
+    byte-identical to a plain scalar-oracle replay of the same trace
+    (no shed, no knob moved, the subsystem invisible) — THEN the A/B
+    on the declared multi-objective score (served throughput / queue
+    wait / fairness), plus a `control rank` pass over the default
+    candidate grid run twice to pin ranking determinism."""
+    from throttlecrab_tpu.control import (
+        ControlReplayer,
+        Policy,
+        default_candidates,
+        rank,
+        rank_json,
+    )
+    from throttlecrab_tpu.replay.generators import synthesize
+    from throttlecrab_tpu.replay.player import (
+        make_target,
+        outcome_vector,
+        replay,
+    )
+    from throttlecrab_tpu.replay.trace import Trace
+
+    if args.replay_trace:
+        trace = Trace.load(args.replay_trace)
+        source = args.replay_trace
+    else:
+        # One fixed shape regardless of --quick: the A/B is only
+        # meaningful in the overload regime where shedding pays — the
+        # static side's virtual backlog must climb well past the 5 ms
+        # AIMD setpoint (it peaks near 100 ms here) while still staying
+        # under the DEFAULT 100k admission bound, so the static side
+        # never sheds and the kill-switch bit-identity proof below
+        # compares stock knobs exactly as a default boot would.  Milder
+        # traces make "do nothing" the correct policy (the log-scaled
+        # objective forgives modest queueing), which tests nothing.
+        trace = synthesize(
+            "flash-crowd",
+            windows=96,
+            batch=2048,
+            key_space=32768,
+            seed=17,
+        )
+        source = "synthetic flash-crowd"
+
+    off = ControlReplayer(
+        trace, Policy(name="static", mode="off")
+    ).run()
+    plain = outcome_vector(replay(trace, make_target("oracle", trace)))
+    identical = off.vector() == plain
+
+    on = ControlReplayer(
+        trace, Policy(name="both", mode="both")
+    ).run()
+
+    ranking = [
+        rank_json(rank(trace, default_candidates(8)))
+        for _ in range(2)
+    ]
+    top = json.loads(ranking[0])[0]
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "control A/B objective score (one trace, virtual "
+                    f"time, 2x overload, same session; {source}, "
+                    f"{len(trace.windows)} windows, "
+                    f"{trace.n_rows()} rows)"
+                ),
+                "static_score": round(off.score, 6),
+                "controller_score": round(on.score, 6),
+                "controller_beats_static": on.score > off.score,
+                "static_max_wait_us": round(off.max_wait_us_seen, 1),
+                "controller_max_wait_us": round(on.max_wait_us_seen, 1),
+                "controller_shed": on.shed,
+                "controller_actuations": on.actuations,
+                "off_bit_identical_to_plain_replay": identical,
+                "rank_top": {
+                    "name": top["policy"]["name"],
+                    "score": top["score"],
+                },
+                "rank_deterministic": ranking[0] == ranking[1],
+                "platform": device.platform,
+            }
+        )
+    )
+    ok = identical and on.score > off.score and ranking[0] == ranking[1]
+    return 0 if ok else 1
 
 
 def run_cluster_bench(args) -> int:

@@ -1,0 +1,514 @@
+"""Adaptive control plane (ISSUE 16, throttlecrab_tpu/control/).
+
+Contracts under test:
+
+- **AIMD convergence under virtual time** — with the queue saturated
+  at the admission bound (sustained overload), the bound converges
+  into a band around target_wait/cost and stays there: multiplicative
+  decrease pulls an overshoot back within one tick, additive increase
+  reclaims headroom, and the forced shed equilibrium never runs away.
+- **Hill-climb monotone improvement with hysteresis** — every accepted
+  move raises the baseline by more than the hysteresis margin (the
+  accepted-baseline sequence is strictly increasing), rejected probes
+  are reverted *exactly*, and a flat objective accepts nothing.
+- **Kill-switch bit-identity** — controller-off simulation outcomes
+  are byte-identical to a plain scalar-oracle replay (no shed, no knob
+  moved), and the default config builds no plane at all.
+- **Actuator bounds / rate limits** — hard clamps at [lo, hi], per-tick
+  max_step slew limiting, integer rounding, no-op writes unlogged, and
+  the bounded actuation log.
+- **`rank` reproducibility** — the K=8 candidate grid ranked twice is
+  byte-identical (canonical JSON), in-process and through the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from throttlecrab_tpu.control import (
+    Actuator,
+    ActuatorRegistry,
+    AIMDController,
+    ControlPlane,
+    ControlReplayer,
+    HillClimber,
+    LOG_CAP,
+    Objective,
+    Policy,
+    Telemetry,
+    build_registry,
+    default_candidates,
+    jain_fairness,
+    rank,
+    rank_json,
+    shed_fraction,
+)
+from throttlecrab_tpu.front.admission import AdmissionController
+from throttlecrab_tpu.replay.generators import save, synthesize
+from throttlecrab_tpu.replay.player import (
+    make_target,
+    outcome_vector,
+    replay,
+)
+from throttlecrab_tpu.server.config import Config
+
+NS = 1_000_000_000
+T0 = 1_753_700_000 * NS
+
+
+class _Box:
+    """Bare attribute holder for actuator getter/setter closures."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def _knob(box, attr, lo, hi, max_step, integer=False, name=None):
+    return Actuator(
+        name=name or attr, unit="x", lo=lo, hi=hi, max_step=max_step,
+        get=lambda: getattr(box, attr),
+        set=lambda v: setattr(box, attr, v),
+        integer=integer,
+    )
+
+
+def _tel(i, wait_us=0.0, shed=0, served=0, hot=0.0, tenants=None):
+    return Telemetry(
+        now_ns=T0 + i * NS,
+        est_wait_us=wait_us,
+        shed_consume=shed,
+        allowed_total=served,
+        hot_concentration=hot,
+        tenant_served=tenants or {},
+    )
+
+
+# --------------------------------------------------------------------
+# actuator registry: bounds, rate limits, logging
+# --------------------------------------------------------------------
+
+
+def test_actuator_clamps_to_hard_bounds():
+    box = _Box(v=50.0)
+    reg = ActuatorRegistry()
+    reg.register(_knob(box, "v", lo=10.0, hi=100.0, max_step=1000.0))
+    assert reg.apply("v", 5000.0, T0) == 100.0
+    assert box.v == 100.0
+    assert reg.apply("v", -3.0, T0) == 10.0
+    assert box.v == 10.0
+    assert reg.clamps == 2
+    assert all(e["clamped"] for e in reg.log)
+
+
+def test_actuator_rate_limits_per_tick_step():
+    box = _Box(v=50.0)
+    reg = ActuatorRegistry()
+    reg.register(_knob(box, "v", lo=0.0, hi=1000.0, max_step=10.0))
+    # In-bounds target, but 450 away: one tick may only move 10.
+    assert reg.apply("v", 500.0, T0) == 60.0
+    assert reg.apply("v", 0.0, T0) == 50.0  # and back down, same limit
+    assert reg.actuations == 2
+
+
+def test_actuator_integer_rounds_and_sets_int():
+    box = _Box(v=100)
+    reg = ActuatorRegistry()
+    reg.register(
+        _knob(box, "v", lo=0, hi=1000, max_step=500, integer=True)
+    )
+    applied = reg.apply("v", 123.7, T0)
+    assert applied == 124.0
+    assert box.v == 124 and isinstance(box.v, int)
+
+
+def test_actuator_noop_write_is_not_logged():
+    box = _Box(v=7.0)
+    reg = ActuatorRegistry()
+    reg.register(_knob(box, "v", lo=0.0, hi=10.0, max_step=5.0))
+    assert reg.apply("v", 7.0, T0) == 7.0
+    assert reg.actuations == 0 and len(reg.log) == 0
+
+
+def test_actuation_log_is_bounded():
+    box = _Box(v=0.0)
+    reg = ActuatorRegistry()
+    reg.register(_knob(box, "v", lo=0.0, hi=1e9, max_step=1.0))
+    for i in range(LOG_CAP + 50):
+        reg.apply("v", box.v + 1.0, T0 + i)
+    assert len(reg.log) == LOG_CAP
+    assert reg.actuations == LOG_CAP + 50
+
+
+def test_registry_rejects_bad_declarations():
+    reg = ActuatorRegistry()
+    box = _Box(v=0.0)
+    with pytest.raises(ValueError):
+        reg.register(_knob(box, "v", lo=10.0, hi=5.0, max_step=1.0))
+    with pytest.raises(ValueError):
+        reg.register(_knob(box, "v", lo=0.0, hi=5.0, max_step=0.0))
+
+
+def test_build_registry_anchors_bounds_to_configured_point():
+    adm = AdmissionController(max_pending=10_000, max_wait_us=50_000)
+    reg = build_registry(admission=adm)
+    lo, hi = reg.bounds("admission.max_pending")
+    assert lo == max(10_000 // 64, 64) and hi == 10_000
+    lo, hi = reg.bounds("admission.max_wait_us")
+    assert lo == max(50_000 // 64, 100) and hi == 50_000
+    # The controller may tighten below config but never relax past it.
+    assert reg.apply("admission.max_pending", 10**9, T0) == 10_000
+
+
+# --------------------------------------------------------------------
+# AIMD: convergence under virtual time
+# --------------------------------------------------------------------
+
+
+def test_aimd_converges_to_target_band_under_overload():
+    """Closed loop under sustained overload: the queue saturates at the
+    bound (wait_us == bound at SIM cost 1 µs/row), arrivals always
+    exceed capacity (shed every tick).  The bound must fall from 100 k
+    into a band around the 5 ms target and stay there."""
+    box = _Box(bound=100_000)
+    reg = ActuatorRegistry()
+    reg.register(_knob(
+        box, "bound", lo=64, hi=100_000, max_step=100_000,
+        integer=True, name="admission.max_pending",
+    ))
+    aimd = AIMDController(target_wait_us=5000.0)
+    prev = None
+    history = []
+    for i in range(60):
+        cur = _tel(i, wait_us=float(box.bound), shed=i + 1, served=i)
+        aimd.tick(prev, cur, reg, T0 + i * NS)
+        prev = cur
+        history.append(box.bound)
+    target, step, factor = 5000.0, 256, 0.7
+    tail = history[30:]
+    # Band: one additive step above target, one multiplicative cut
+    # below the highest healthy point.
+    lo_band = (target + step) * factor - step
+    hi_band = target + step
+    assert all(lo_band <= b <= hi_band for b in tail), tail
+    # And it is live regulation, not a frozen knob.
+    assert len(set(tail)) > 1
+    assert reg.actuations > 0
+
+
+def test_aimd_additive_increase_only_when_shedding():
+    """Healthy and not shedding: the bound is not binding, so AIMD must
+    leave it alone (no pointless drift toward the ceiling)."""
+    box = _Box(bound=1000)
+    reg = ActuatorRegistry()
+    reg.register(_knob(
+        box, "bound", lo=64, hi=100_000, max_step=100_000,
+        integer=True, name="admission.max_pending",
+    ))
+    aimd = AIMDController(target_wait_us=5000.0)
+    prev = _tel(0, wait_us=100.0, shed=0, served=10)
+    cur = _tel(1, wait_us=100.0, shed=0, served=20)
+    aimd.tick(prev, cur, reg, T0)
+    assert box.bound == 1000
+    # Same telemetry but with fresh shed: bound relaxes additively.
+    cur2 = _tel(2, wait_us=100.0, shed=5, served=30)
+    aimd.tick(cur, cur2, reg, T0 + NS)
+    assert box.bound == 1256
+
+
+def test_aimd_hot_weight_rises_under_hot_congestion_then_decays():
+    box = _Box(w=0.0)
+    reg = ActuatorRegistry()
+    reg.register(_knob(
+        box, "w", lo=0.0, hi=1.0, max_step=0.1,
+        name="admission.hot_shed_weight",
+    ))
+    aimd = AIMDController(target_wait_us=5000.0)
+    congested_hot = _tel(1, wait_us=50_000.0, hot=0.9)
+    aimd.tick(None, congested_hot, reg, T0)
+    assert box.w == pytest.approx(0.05)
+    aimd.tick(congested_hot, _tel(2, wait_us=50_000.0, hot=0.9), reg, T0)
+    assert box.w == pytest.approx(0.10)
+    # Pressure gone: multiplicative decay back toward zero.
+    aimd.tick(None, _tel(3, wait_us=100.0), reg, T0)
+    assert box.w == pytest.approx(0.07)
+
+
+# --------------------------------------------------------------------
+# hill climber: monotone improvement, hysteresis, exact revert
+# --------------------------------------------------------------------
+
+
+def _hill_loop(hill, reg, box, score_of, ticks):
+    """Drive the climber with the score measured at the CURRENT knob
+    value each virtual tick; returns the accepted-baseline history."""
+    baselines = []
+    last = None
+    for i in range(ticks):
+        hill.tick(score_of(box.x), reg, T0 + i * NS)
+        b = hill.stats()["baseline"]
+        if b is not None and b != last:
+            baselines.append(b)
+            last = b
+    return baselines
+
+
+def test_hill_climbs_to_optimum_with_monotone_baselines():
+    box = _Box(x=2.0)
+    reg = ActuatorRegistry()
+    reg.register(_knob(box, "x", lo=0.0, hi=10.0, max_step=10.0))
+    hill = HillClimber(["x"], step_frac=0.125, eval_ticks=2,
+                       hysteresis=0.01)
+    score_of = lambda x: -((x - 7.0) ** 2)  # optimum at x = 7
+    baselines = _hill_loop(hill, reg, box, score_of, 60)
+    # Strictly increasing accepted baselines: every kept move improved
+    # the objective (the monotone-improvement contract).
+    assert all(b > a for a, b in zip(baselines, baselines[1:]))
+    assert hill.moves_accepted >= 3
+    # Converged next to the optimum (within one probe step of 1.25).
+    assert abs(box.x - 7.0) <= 1.25 + 1e-9
+    assert hill.moves_reverted > 0  # overshoot probes were rejected
+
+
+def test_hill_hysteresis_blocks_noise_and_reverts_exactly():
+    """Flat objective: no probe can beat baseline + hysteresis, so
+    nothing is ever accepted and every probe is reverted to the exact
+    starting value."""
+    box = _Box(x=4.0)
+    reg = ActuatorRegistry()
+    reg.register(_knob(box, "x", lo=0.0, hi=10.0, max_step=10.0))
+    hill = HillClimber(["x"], eval_ticks=2, hysteresis=0.01)
+    for i in range(40):
+        hill.tick(1.0, reg, T0 + i * NS)
+    assert hill.moves_accepted == 0
+    assert hill.moves_reverted > 0
+    # Exact revert: after any settled (non-probing) tick the knob is
+    # back at its original value.
+    hill.tick(1.0, reg, T0 + 100 * NS)
+    settled = {4.0, 4.0 + 1.25, 4.0 - 1.25}
+    assert box.x in settled  # mid-probe at worst, never drifted
+
+
+def test_hill_skips_pinned_coordinate_without_burning_a_window():
+    box = _Box(x=10.0, y=5.0)
+    reg = ActuatorRegistry()
+    reg.register(_knob(box, "x", lo=10.0, hi=10.0,
+                       max_step=1.0))  # lo == hi: every probe a no-op
+    reg.register(_knob(box, "y", lo=0.0, hi=10.0, max_step=10.0))
+    hill = HillClimber(["x", "y"], eval_ticks=1, hysteresis=1e9)
+    for i in range(12):
+        hill.tick(0.0, reg, T0 + i * NS)
+    # The pinned coordinate never produced an actuation; the live one
+    # did (probes), all reverted under the impossible hysteresis.
+    assert all(e["actuator"] == "y" for e in reg.log)
+
+
+# --------------------------------------------------------------------
+# objective
+# --------------------------------------------------------------------
+
+
+def test_objective_scores_throughput_wait_fairness():
+    obj = Objective()
+    base = _tel(0, served=0)
+    fast = _tel(1, wait_us=0.0, served=1000)
+    slow = _tel(1, wait_us=50_000.0, served=1000)
+    assert obj.score(base, fast) > obj.score(base, slow)
+    unfair = _tel(1, served=1000,
+                  tenants={"a": 990, "b": 5, "c": 5})
+    fair = _tel(1, served=1000,
+                tenants={"a": 334, "b": 333, "c": 333})
+    assert obj.score(base, fair) > obj.score(base, unfair)
+
+
+def test_jain_fairness_bounds():
+    assert jain_fairness({}) == 1.0
+    assert jain_fairness({"a": 10}) == 1.0
+    assert jain_fairness({"a": 5, "b": 5}) == pytest.approx(1.0)
+    skew = jain_fairness({"a": 1000, "b": 1})
+    assert 0.5 <= skew < 0.51
+
+
+def test_shed_fraction_differences_consecutive_records():
+    prev = _tel(0, shed=10, served=90)
+    cur = _tel(1, shed=30, served=150)
+    # This tick: 20 shed, 60 served -> 20/80.
+    assert shed_fraction(prev, cur) == pytest.approx(0.25)
+    assert shed_fraction(None, cur) == pytest.approx(30 / 180)
+
+
+# --------------------------------------------------------------------
+# control plane: cadence, lock plumbing, stats
+# --------------------------------------------------------------------
+
+
+class _StubBus:
+    def snapshot(self, now_ns, queue_depth=0):
+        return _tel(0)
+
+
+class _RecordingLock:
+    def __init__(self):
+        self.entries = 0
+        self._lock = threading.Lock()
+
+    def __enter__(self):
+        self.entries += 1
+        return self._lock.__enter__()
+
+    def __exit__(self, *exc):
+        return self._lock.__exit__(*exc)
+
+
+def _plane(mode="both", tick_ms=1000):
+    box = _Box(bound=1000)
+    reg = ActuatorRegistry()
+    reg.register(_knob(
+        box, "bound", lo=64, hi=100_000, max_step=100_000,
+        integer=True, name="admission.max_pending",
+    ))
+    return ControlPlane(_StubBus(), reg, mode=mode, tick_ms=tick_ms)
+
+
+def test_plane_tick_cadence_is_throttled():
+    plane = _plane(tick_ms=1000)
+    assert plane.maybe_tick(T0) is True
+    assert plane.maybe_tick(T0 + NS // 2) is False
+    assert plane.maybe_tick(T0 + NS) is True
+    assert plane.ticks == 2
+
+
+def test_plane_tick_lock_overrides_caller_lock():
+    plane = _plane()
+    caller, cluster = _RecordingLock(), _RecordingLock()
+    plane.maybe_tick(T0, caller)
+    assert caller.entries == 1
+    plane.tick_lock = cluster  # cluster mode: device_lock wins
+    plane.maybe_tick(T0 + 2 * NS, caller)
+    assert caller.entries == 1 and cluster.entries == 1
+
+
+def test_plane_stats_document_shape():
+    plane = _plane(mode="both")
+    plane.maybe_tick(T0)
+    doc = json.loads(plane.stats_json())
+    assert doc["control"]["enabled"] is True
+    assert doc["control"]["mode"] == "both"
+    assert doc["control"]["ticks"] == 1
+    assert set(doc["objective"]["weights"]) == {
+        "throughput", "wait", "fairness"
+    }
+    assert "admission.max_pending" in doc["actuators"]
+    assert "hill" in doc
+    assert set(plane.metric_stats()) == {
+        "ticks", "actuations", "clamped", "objective", "shed_rate"
+    }
+
+
+def test_plane_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        _plane(mode="banana")
+
+
+# --------------------------------------------------------------------
+# kill switch: bit-identity + nothing built
+# --------------------------------------------------------------------
+
+
+def _small_trace(windows=24, batch=128, seed=23):
+    return synthesize("flash-crowd", windows=windows, batch=batch,
+                      key_space=2048, seed=seed)
+
+
+def test_default_config_builds_no_control_plane():
+    from throttlecrab_tpu.control import create_control_plane
+
+    cfg = Config.from_env_and_args(["--http"])
+    assert cfg.control is False
+    assert create_control_plane(cfg) is None
+
+
+def test_controller_off_is_bit_identical_to_plain_replay():
+    trace = _small_trace()
+    off = ControlReplayer(trace, Policy(name="static", mode="off")).run()
+    plain = outcome_vector(replay(trace, make_target("oracle", trace)))
+    assert off.vector() == plain
+    assert off.shed == 0
+    assert off.actuations == 0 and off.actuation_log == []
+    # The default knobs never moved.
+    assert off.final_max_pending == 100_000
+
+
+def test_armed_controller_tightens_bound_and_caps_wait():
+    trace = _small_trace(windows=32, batch=1024)
+    # Harsh overload (4x) so even the small trace pressures the loop.
+    rate = 0.25 * trace.n_rows() / ControlReplayer._duration_s(trace)
+    off = ControlReplayer(
+        trace, Policy(name="static", mode="off"), service_rate=rate
+    ).run()
+    on = ControlReplayer(
+        trace, Policy(name="aimd", mode="aimd"), service_rate=rate
+    ).run()
+    assert on.actuations > 0
+    assert on.shed > 0
+    assert on.final_max_pending < 100_000
+    assert on.max_wait_us_seen < off.max_wait_us_seen
+
+
+def test_config_validates_control_knobs():
+    with pytest.raises(ValueError):
+        Config.from_env_and_args(["--http", "--control-mode", "banana"])
+    with pytest.raises(ValueError):
+        Config.from_env_and_args(["--http", "--control-tick-ms", "0"])
+    with pytest.raises(ValueError):
+        Config.from_env_and_args(["--http", "--control-w-wait", "-1"])
+
+
+# --------------------------------------------------------------------
+# offline policy search: rank reproducibility
+# --------------------------------------------------------------------
+
+
+def test_rank_is_reproducible_and_complete():
+    trace = _small_trace()
+    cands = default_candidates(8)
+    assert len(cands) == 8
+    assert len({p.name for p in cands}) == 8
+    r1 = rank(trace, cands)
+    r2 = rank(trace, cands)
+    assert rank_json(r1) == rank_json(r2)
+    assert [row["rank"] for row in r1] == list(range(1, 9))
+    names = {row["policy"]["name"] for row in r1}
+    assert "static" in names
+    scores = [row["score"] for row in r1]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_default_candidates_extend_past_fixed_head():
+    cands = default_candidates(11)
+    assert len(cands) == 11
+    assert len({p.name for p in cands}) == 11
+
+
+def test_rank_cli_emits_canonical_json(tmp_path):
+    trace = _small_trace(windows=12, batch=64)
+    path = os.path.join(tmp_path, "t.tctr")
+    save(trace, path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    outs = []
+    for _ in range(2):
+        p = subprocess.run(
+            [sys.executable, "-m", "throttlecrab_tpu.control", "rank",
+             path, "-k", "8", "--json"],
+            capture_output=True, env=env, timeout=240,
+        )
+        assert p.returncode == 0, p.stderr.decode()
+        outs.append(p.stdout)
+    assert outs[0] == outs[1]
+    ranking = json.loads(outs[0])
+    assert len(ranking) == 8 and ranking[0]["rank"] == 1

@@ -189,6 +189,29 @@ class PerfResult:
         idx = min(int(q * len(data)), len(data) - 1)
         return data[idx] * 1e3
 
+    def objective_score(self) -> float:
+        """The control plane's declared multi-objective score, computed
+        from the measured run (control/controllers.Objective with the
+        default weights): log-compressed served throughput, minus
+        log-compressed p99 wait, plus per-tenant Jain fairness.  The
+        same yardstick `python -m throttlecrab_tpu.control rank` uses,
+        so live runs and offline policy search are comparable."""
+        import math
+
+        from ..control import jain_fairness
+
+        served = self.allowed + self.denied
+        rate = served / self.elapsed_s if self.elapsed_s else 0.0
+        wait_us = self.percentile_ms(0.99) * 1e3
+        fairness = jain_fairness(
+            {t: a + d for t, (a, d, _e) in self.tenant_counts.items()}
+        )
+        return (
+            math.log1p(max(rate, 0.0))
+            - math.log1p(max(wait_us, 0.0))
+            + 0.5 * fairness
+        )
+
     def summary(self) -> dict:
         return {
             "transport": self.transport,
@@ -204,6 +227,10 @@ class PerfResult:
             "p90_ms": round(self.percentile_ms(0.90), 3),
             "p99_ms": round(self.percentile_ms(0.99), 3),
             "p99_9_ms": round(self.percentile_ms(0.999), 3),
+            # The control plane's multi-objective yardstick (L3.9):
+            # comparable across live runs, bench A/Bs, and offline
+            # `control rank` output.
+            "objective": round(self.objective_score(), 6),
         }
 
 
@@ -680,7 +707,8 @@ def main(argv=None) -> int:
     p.add_argument("--key-pattern", default="random",
                    choices=["sequential", "random", "zipfian",
                             "user-resource", "hotkey-abuse",
-                            "flash-crowd", "chaos", "noisy-neighbor"])
+                            "flash-crowd", "chaos", "noisy-neighbor",
+                            "diurnal", "slow-drift"])
     p.add_argument("--stats", action="store_true",
                    help="poll GET /stats (the insight tier) every "
                         "200 ms during the run and report hot-key "

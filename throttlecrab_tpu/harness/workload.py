@@ -130,6 +130,38 @@ def make_keys(
             u < 0.4, hot, np.where(u < 0.5, spray, k_other)
         )
         return [f"t{t}:key:{k}" for t, k in zip(tid, kid)]
+    elif pattern == "diurnal":
+        # Live twin of the synthetic diurnal trace generator
+        # (replay/generators.py): the whole "day" is compressed into
+        # the request stream — keys draw Zipf-skewed from a fixed
+        # population, but the DRAW INTENSITY follows a sinusoidal
+        # cycle: at the day's peak the stream concentrates on the hot
+        # head (the skew the control plane's AIMD loop must absorb), in
+        # the trough it spreads into the cold tail.  Pairs with the
+        # `wave` arrival pattern for the full load cycle.
+        ranks = np.arange(1, key_space + 1, dtype=np.float64)
+        p_hot = ranks**-1.1
+        p_hot /= p_hot.sum()
+        pos = np.arange(n_requests)
+        phase = np.sin(2 * np.pi * pos / max(n_requests, 1))
+        hot_draw = rng.choice(key_space, size=n_requests, p=p_hot)
+        cold_draw = rng.integers(0, key_space, n_requests)
+        # Peak hours: ~95% of draws from the skewed head; trough: ~50%.
+        is_peak = rng.random(n_requests) < (0.725 + 0.225 * phase)
+        ids = np.where(is_peak, hot_draw, cold_draw)
+    elif pattern == "slow-drift":
+        # Live twin of the synthetic slow-drift generator: the key
+        # population slides over the run — each request draws from a
+        # window of `key_space` ids whose base advances with stream
+        # position, so old keys expire out and fresh keys trickle in
+        # for the whole run (keymap-growth and sweep pressure, the
+        # long-soak shape; seed-offset so every worker/run drifts over
+        # its own band).
+        drift_span = key_space  # total drift over the run: one full population
+        pos = np.arange(n_requests)
+        lo = (pos * drift_span) // max(n_requests, 1)
+        lo = lo + (seed + 1) * drift_span
+        ids = lo + rng.integers(0, key_space, n_requests)
     elif pattern == "chaos":
         # The chaos-run companion (harness --chaos) for a server armed
         # with THROTTLECRAB_FAULTS: half hot-key abuse (exercises the

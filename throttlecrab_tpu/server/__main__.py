@@ -18,6 +18,7 @@ from .engine import BatchingEngine
 from .metrics import Metrics
 from .store import (
     create_cleanup_policy,
+    create_control,
     create_front_tier,
     create_insight,
     create_limiter,
@@ -56,6 +57,7 @@ def build_transports(config: Config, engine, metrics):
                     now_fn=engine.now_fn,
                     front=engine.front,
                     insight=engine.insight,
+                    control=engine.control,
                 )
             )
         else:
@@ -95,6 +97,7 @@ def build_transports(config: Config, engine, metrics):
                     now_fn=engine.now_fn,
                     front=engine.front,
                     insight=engine.insight,
+                    control=engine.control,
                 )
             )
         else:
@@ -269,16 +272,29 @@ async def run_server(config: Config) -> None:
         # engine.limiter_lock); the insight poll must use the same one
         # or it races the RPC path's donated state buffers.
         insight.poll_lock = limiter.device_lock
+    cleanup_policy = create_cleanup_policy(config)
+    # Control plane (L3.9): adaptive feedback over the knob surface the
+    # tiers above just built.  Off by default (THROTTLECRAB_CONTROL=0):
+    # create_control returns None, nothing ticks, no knob ever moves.
+    control = create_control(
+        config, metrics, limiter, front, insight, cleanup_policy
+    )
+    if cluster_nodes and control is not None:
+        # Same reasoning as the insight poll_lock override above: in
+        # cluster mode the device is serialized by the cluster's device
+        # lock, and the control tick's sensor reads ride that hold.
+        control.tick_lock = limiter.device_lock
     engine = BatchingEngine(
         limiter,
         batch_size=config.batch_size,
         max_linger_us=config.max_linger_us,
         max_scan_depth=config.max_scan_depth,
-        cleanup_policy=create_cleanup_policy(config),
+        cleanup_policy=cleanup_policy,
         metrics=metrics,
         profile_dir=config.profile_dir or None,
         front=front,
         insight=insight,
+        control=control,
     )
     transports = build_transports(config, engine, metrics)
     if cluster_nodes:

@@ -227,6 +227,30 @@ _SPEC = [
     ("insight_shed_weight", "THROTTLECRAB_INSIGHT_SHED_WEIGHT", 0.0, float,
      "Scale admission-control peek shedding by hot-set concentration "
      "(0 disables; 1 = full tightening under pure abuse traffic)"),
+    # --- control plane (L3.9: adaptive feedback over the knob surface) --
+    ("control", "THROTTLECRAB_CONTROL", False, bool,
+     "Adaptive control plane: telemetry-driven feedback controllers "
+     "moving admission/deny-cache/insight knobs through a bounded "
+     "actuator registry (env 0 — the default — builds none of it; "
+     "decisions and every knob value are bit-identical to the "
+     "subsystem absent)"),
+    ("control_tick_ms", "THROTTLECRAB_CONTROL_TICK_MS", 1000, int,
+     "Cadence of the control tick (sensor snapshot + controller step; "
+     "milliseconds) in the engine flush loop / native driver"),
+    ("control_mode", "THROTTLECRAB_CONTROL_MODE", "both", str,
+     "Armed controllers: aimd (fast loop on admission), hill "
+     "(coordinate-descent slow loop), or both"),
+    ("control_target_wait_us", "THROTTLECRAB_CONTROL_TARGET_WAIT_US",
+     5000.0, float,
+     "AIMD setpoint: estimated queue wait (microseconds) above which "
+     "the admission bound decreases multiplicatively"),
+    ("control_w_throughput", "THROTTLECRAB_CONTROL_W_THROUGHPUT",
+     1.0, float,
+     "Objective weight on served throughput (log-compressed rows/s)"),
+    ("control_w_wait", "THROTTLECRAB_CONTROL_W_WAIT", 1.0, float,
+     "Objective weight on estimated queue wait (log-compressed us)"),
+    ("control_w_fairness", "THROTTLECRAB_CONTROL_W_FAIRNESS", 0.5, float,
+     "Objective weight on per-tenant Jain fairness ([0, 1] term)"),
 ]
 
 
@@ -301,6 +325,13 @@ class Config:
     insight_prewarm: int = 64
     insight_hot_denies: int = 100
     insight_shed_weight: float = 0.0
+    control: bool = False
+    control_tick_ms: int = 1000
+    control_mode: str = "both"
+    control_target_wait_us: float = 5000.0
+    control_w_throughput: float = 1.0
+    control_w_wait: float = 1.0
+    control_w_fairness: float = 0.5
 
     @classmethod
     def from_env_and_args(
@@ -410,6 +441,21 @@ class Config:
             )
         if not 0.0 <= self.insight_shed_weight <= 1.0:
             raise ConfigError("insight_shed_weight must be in [0, 1]")
+        if self.control_mode not in ("aimd", "hill", "both"):
+            raise ConfigError(
+                f"Invalid control mode: {self.control_mode!r} "
+                "(expected aimd, hill, or both)"
+            )
+        if self.control_tick_ms <= 0:
+            raise ConfigError("control_tick_ms must be > 0")
+        if self.control_target_wait_us <= 0:
+            raise ConfigError("control_target_wait_us must be > 0")
+        if (
+            self.control_w_throughput < 0
+            or self.control_w_wait < 0
+            or self.control_w_fairness < 0
+        ):
+            raise ConfigError("control objective weights must be >= 0")
         if self.faults:
             from ..faults import parse_spec
 

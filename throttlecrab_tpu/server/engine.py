@@ -78,6 +78,7 @@ class BatchingEngine:
         max_scan_depth: int = 16,
         front=None,
         insight=None,
+        control=None,
     ) -> None:
         """`limiter` is a TpuRateLimiter / ShardedTpuRateLimiter (or any
         object with rate_limit_batch + sweep).  `now_fn` injects time for
@@ -91,13 +92,17 @@ class BatchingEngine:
         insight.InsightTier (L3.75): the engine drives its throttled
         device poll between flushes (on the executor — the poll fetch
         synchronizes with in-flight launches) and serves its document
-        on GET /stats."""
+        on GET /stats.  `control` is an optional control.ControlPlane
+        (L3.9): the engine drives its throttled tick between flushes
+        under the same discipline (None — the default — means no
+        sensor read and no knob ever moves)."""
         import threading
         import time
 
         self.limiter = limiter
         self.front = front
         self.insight = insight
+        self.control = control
         # Serializes device access with native transports that drive the
         # same limiter from their own threads (server/native_redis.py).
         self.limiter_lock = threading.Lock()
@@ -600,6 +605,20 @@ class BatchingEngine:
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(
                 None, insight.maybe_poll, now_ns, self.limiter_lock
+            )
+        control = self.control
+        if control is not None and control.tick_due(now_ns):
+            # Throttled control tick (L3.9): sensor snapshot + feedback
+            # step, off the event loop under the same lock discipline
+            # as the insight poll (the sensors it reads are the leaf
+            # locks ranked above its own in analysis/lockorder.toml).
+            depth = len(self._pending)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None,
+                lambda: control.maybe_tick(
+                    now_ns, self.limiter_lock, queue_depth=depth
+                ),
             )
         policy = self.cleanup_policy
         if policy is None:

@@ -181,6 +181,17 @@ class HttpTransport(ConnTrackingMixin):
                 "stats": recorder.stats(),
             }).encode()
             return 200, payload, "application/json"
+        if method == "GET" and path == "/control":
+            # Control-plane JSON (L3.9): mode, tick count, objective
+            # score, actuator values/bounds, and the bounded actuation
+            # log.  With the plane disabled the shape still answers
+            # (enabled: false) so pollers need no probe logic.
+            control = getattr(self.engine, "control", None)
+            if control is None:
+                payload = json.dumps({"control": {"enabled": False}})
+            else:
+                payload = control.stats_json()
+            return 200, payload.encode(), "application/json"
         if method == "GET" and path == "/metrics":
             return (
                 200,

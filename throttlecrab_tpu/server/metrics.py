@@ -76,6 +76,12 @@ METRIC_NAMES = (
     "throttlecrab_tpu_tenant_allowed",
     "throttlecrab_tpu_tenant_denied",
     "throttlecrab_tpu_tenant_quota_rejections",
+    # Control plane (L3.9, control/).
+    "throttlecrab_tpu_control_ticks",
+    "throttlecrab_tpu_control_actuations",
+    "throttlecrab_tpu_control_clamped",
+    "throttlecrab_tpu_control_objective",
+    "throttlecrab_tpu_control_shed_rate",
 )
 
 
@@ -159,6 +165,8 @@ class Metrics:
         self._engine_state = None
         # Insight tier (L3.75).
         self._insight_stats = None
+        # Control plane (L3.9).
+        self._control_stats = None
         # Tenant/namespace layer (sharded mesh).
         self._tenant_stats = None
 
@@ -291,6 +299,11 @@ class Metrics:
         """`provider()` -> InsightTier.metric_stats(); exported as the
         throttlecrab_tpu_insight_* gauges (zeros when absent)."""
         self._insight_stats = provider
+
+    def set_control_stats_provider(self, provider) -> None:
+        """`provider()` -> ControlPlane.metric_stats(); exported as the
+        throttlecrab_tpu_control_* gauges (zeros when absent)."""
+        self._control_stats = provider
 
     def set_cluster_stats_provider(self, provider) -> None:
         """`provider()` -> {peer_addr: {"forwarded": n, "failed": n,
@@ -537,6 +550,39 @@ class Metrics:
             "Device insight polls (accumulator fetch + top-K launch)",
             "counter",
             ins.get("polls", 0),
+        )
+        # Control plane (L3.9, control/).
+        ctl = self._control_stats() if self._control_stats else {}
+        metric(
+            "throttlecrab_tpu_control_ticks",
+            "Control-plane ticks (sensor snapshot + controller step)",
+            "counter",
+            ctl.get("ticks", 0),
+        )
+        metric(
+            "throttlecrab_tpu_control_actuations",
+            "Knob moves applied through the actuator registry",
+            "counter",
+            ctl.get("actuations", 0),
+        )
+        metric(
+            "throttlecrab_tpu_control_clamped",
+            "Actuations clamped by declared bounds or rate limits",
+            "counter",
+            ctl.get("clamped", 0),
+        )
+        metric(
+            "throttlecrab_tpu_control_objective",
+            "Last multi-objective score "
+            "(throughput / wait / fairness, weighted)",
+            "gauge",
+            ctl.get("objective", 0),
+        )
+        metric(
+            "throttlecrab_tpu_control_shed_rate",
+            "Shed fraction of arrivals over the last control tick",
+            "gauge",
+            ctl.get("shed_rate", 0),
         )
         # Tenant/namespace layer (sharded mesh deployments only).
         tenant_provider = getattr(self, "_tenant_stats", None)

@@ -60,6 +60,7 @@ class NativeRedisTransport:
         max_scan_depth: int = 16,
         front=None,
         insight=None,
+        control=None,
     ) -> None:
         lib = get_wire_lib()
         if lib is None:
@@ -74,6 +75,10 @@ class NativeRedisTransport:
         # into the C++ wire layer (HTTP protocol) alongside
         # health/metrics.
         self.insight = insight
+        # Control plane (L3.9): this driver thread also drives the
+        # throttled control tick, right after the insight poll (None —
+        # the default — means no sensor read and no knob ever moves).
+        self.control = control
         # Front tier (L3.5): shared with the asyncio engine, so a deny
         # cached on one transport serves (and is invalidated by) all of
         # them.  The lookup runs in this driver BEFORE batch prep —
@@ -557,6 +562,13 @@ class NativeRedisTransport:
             # Throttled (~1/s) insight poll; this driver thread may
             # block on the device, exactly like its decide launches.
             self.insight.maybe_poll(now_ns, self.limiter_lock)
+        if self.control is not None:
+            # Throttled control tick, same discipline.  The native wire
+            # layer holds its own pending queue device-side of this
+            # driver, so depth 0 is the honest engine-queue reading —
+            # admission's EWMA wait still carries the launch-cost
+            # signal.
+            self.control.maybe_tick(now_ns, self.limiter_lock)
         if self.metrics is not None and (
             any_launch or tot_errors
         ):

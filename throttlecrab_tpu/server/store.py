@@ -209,6 +209,33 @@ def create_insight(config, metrics, limiter, front):
     return insight
 
 
+def create_control(config, metrics, limiter, front, insight,
+                   cleanup_policy):
+    """Build the control plane (L3.9: adaptive feedback over the knob
+    surface) from the THROTTLECRAB_CONTROL_* knobs, or None when
+    disabled — the kill switch builds NOTHING, so decisions and every
+    knob value are bit-identical to the subsystem absent.  Sensors and
+    actuators register only for the subsystems this deployment actually
+    built (a front-less boot simply has fewer knobs to move)."""
+    from ..control import create_control_plane
+
+    plane = create_control_plane(
+        config,
+        front=front,
+        insight=insight,
+        cleanup_policy=cleanup_policy,
+        limiter=limiter,
+        metrics=metrics,
+    )
+    if plane is not None:
+        log.info(
+            "control plane armed: mode=%s tick=%dms actuators=%s",
+            config.control_mode, config.control_tick_ms,
+            ",".join(plane.registry.names()),
+        )
+    return plane
+
+
 def create_cleanup_policy(config) -> CleanupPolicy:
     """store.rs:57-87: the store type decides when cleanup runs."""
     if config.store == "periodic":
