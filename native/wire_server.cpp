@@ -36,6 +36,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <deque>
@@ -63,6 +64,11 @@ struct PendingRequest {
     uint64_t slot_seq;   // position in the connection's response order
     std::string key;
     int64_t max_burst, count_per_period, period, quantity;
+    // Absolute client deadline on the CLOCK_MONOTONIC ms clock
+    // (0 = none).  Stamped at parse time; ws_next_batch converts it to
+    // a remaining-budget column so the driver sheds expired rows
+    // before device dispatch.
+    int64_t deadline_ms = 0;
     bool keep_alive = true;  // HTTP only: close after responding if false
 };
 
@@ -710,8 +716,9 @@ struct WireServer {
         std::string method = request_line.substr(0, sp1);
         std::string path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
 
-        // Headers we care about: content-length, connection.
+        // Headers we care about: content-length, connection, deadline.
         int64_t content_length = 0;
+        int64_t deadline_rel_ms = 0;
         bool keep_alive = true;
         size_t pos = line_end == std::string::npos ? head.size()
                                                    : line_end + 2;
@@ -737,6 +744,13 @@ struct WireServer {
                 }
             } else if (name == "CONNECTION") {
                 keep_alive = upper(value) != "CLOSE";
+            } else if (name == "X-THROTTLECRAB-DEADLINE-MS") {
+                // Optional client deadline, relative ms; malformed or
+                // non-positive values are ignored (deadline unset) —
+                // a bad hint must not fail an otherwise-valid request.
+                int64_t v;
+                if (parse_i64_ascii(value, v) && v > 0)
+                    deadline_rel_ms = v;
             }
         }
         size_t total = head_end + 4 + content_length;
@@ -799,6 +813,8 @@ struct WireServer {
         }
         if (!json_int(body, "quantity", req.quantity))
             req.quantity = 1;  // http.rs:135
+        if (deadline_rel_ms > 0)
+            req.deadline_ms = now_ms() + deadline_rel_ms;
         req.slot_seq = reserve_slot(c);
         {
             std::lock_guard<std::mutex> lk(q_mu);
@@ -854,7 +870,7 @@ struct WireServer {
             emit_inline(c, "-ERR unknown command '" + cmd + "'\r\n", false);
             return false;
         }
-        if (args.size() < 5 || args.size() > 6) {
+        if (args.size() < 5 || args.size() > 7) {
             emit_inline(
                 c,
                 "-ERR wrong number of arguments for 'throttle' "
@@ -885,10 +901,20 @@ struct WireServer {
             return false;
         }
         req.quantity = 1;
-        if (args.size() == 6 &&
+        if (args.size() >= 6 &&
             (nulls[5] || !parse_i64_ascii(args[5], req.quantity))) {
             emit_inline(c, "-ERR invalid quantity\r\n", false);
             return false;
+        }
+        // Optional 7th token: client deadline in relative milliseconds
+        // (matches the asyncio backend's extended THROTTLE arity).
+        if (args.size() == 7) {
+            int64_t dl_ms;
+            if (nulls[6] || !parse_i64_ascii(args[6], dl_ms)) {
+                emit_inline(c, "-ERR invalid deadline_ms\r\n", false);
+                return false;
+            }
+            if (dl_ms > 0) req.deadline_ms = now_ms() + dl_ms;
         }
         req.slot_seq = reserve_slot(c);
         {
@@ -1010,11 +1036,14 @@ void ws_destroy(void* h) {
 
 // Blocks up to timeout_us for pending THROTTLE requests, then fills up to
 // max_n of them.  Key bytes are concatenated into key_buf (cap key_buf_len)
-// with offsets[n+1]; params land in the i64 arrays; cookies (conn gen+fd)
-// identify where the response goes.  Returns n (0 on timeout/shutdown).
+// with offsets[n+1]; params land in the i64 arrays (stride 5: max_burst,
+// count_per_period, period, quantity, remaining deadline budget in ns —
+// 0 = no deadline, negative = already expired at pop); cookies (conn
+// gen+fd) identify where the response goes.  Returns n (0 on
+// timeout/shutdown).
 int64_t ws_next_batch(void* h, int64_t timeout_us, int64_t max_n,
                       char* key_buf, int64_t key_buf_len, int64_t* offsets,
-                      int64_t* params /* [4 * max_n] interleaved */,
+                      int64_t* params /* [5 * max_n] interleaved */,
                       uint64_t* cookie_gen, int32_t* cookie_fd) {
     auto* s = static_cast<WireServer*>(h);
     std::unique_lock<std::mutex> lk(s->q_mu);
@@ -1025,6 +1054,7 @@ int64_t ws_next_batch(void* h, int64_t timeout_us, int64_t max_n,
     }
     int64_t n = 0;
     int64_t key_off = 0;
+    int64_t now = now_ms();
     offsets[0] = 0;
     while (n < max_n && !s->queue.empty()) {
         PendingRequest& req = s->queue.front();
@@ -1040,10 +1070,17 @@ int64_t ws_next_batch(void* h, int64_t timeout_us, int64_t max_n,
         memcpy(key_buf + key_off, req.key.data(), req.key.size());
         key_off += req.key.size();
         offsets[n + 1] = key_off;
-        params[4 * n + 0] = req.max_burst;
-        params[4 * n + 1] = req.count_per_period;
-        params[4 * n + 2] = req.period;
-        params[4 * n + 3] = req.quantity;
+        params[5 * n + 0] = req.max_burst;
+        params[5 * n + 1] = req.count_per_period;
+        params[5 * n + 2] = req.period;
+        params[5 * n + 3] = req.quantity;
+        // Remaining budget at pop time; clamp expired to -1 so the
+        // driver can shed without re-reading the clock.
+        params[5 * n + 4] =
+            req.deadline_ms == 0
+                ? 0
+                : std::max<int64_t>((req.deadline_ms - now) * 1'000'000,
+                                    -1);
         cookie_gen[n] = req.conn_gen;
         cookie_fd[n] = req.fd;
         s->inflight.push_back(
@@ -1108,6 +1145,12 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                     code = 503;
                     body = "{\"error\": \"tenant capacity quota "
                            "exceeded\"}";
+                } else if (status[i] == 6) {
+                    // Client deadline lapsed before dispatch: 504 is
+                    // the timeout status — distinct from overload so
+                    // callers can size their deadlines, not back off.
+                    code = 504;
+                    body = "{\"error\": \"deadline exceeded\"}";
                 } else {
                     code = 500;  // engine-level error (http.rs:148-157)
                     body = status[i] == 1
@@ -1120,6 +1163,7 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                 }
                 const char* reason = code == 200   ? "OK"
                                      : code == 503 ? "Service Unavailable"
+                                     : code == 504 ? "Gateway Timeout"
                                                    : "Internal Server Error";
                 char head[224];
                 int hn = snprintf(
@@ -1149,6 +1193,8 @@ void ws_respond(void* h, int64_t n, const uint64_t* cookie_gen,
                 payload = "-ERR server overloaded\r\n";
             } else if (status[i] == 5) {
                 payload = "-ERR tenant capacity quota exceeded\r\n";
+            } else if (status[i] == 6) {
+                payload = "-ERR deadline exceeded\r\n";
             } else {
                 payload = "-ERR internal error\r\n";
             }

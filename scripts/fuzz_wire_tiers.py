@@ -378,7 +378,8 @@ def run_hotkey_deny_seed(seed, steps):
 
 def run_cluster_frame_fuzz(seed, iters):
     """Malformed-frame hardening for the elastic-cluster codecs
-    (OP_MIGRATE/OP_REPLICA rows, OP_RING weights, OP_ROUTE_BATCH):
+    (OP_MIGRATE/OP_REPLICA rows, OP_RING weights, OP_ROUTE_BATCH,
+    OP_DROUTE_BATCH deadline routes, OP_LEAVE):
     random truncations, byte flips and splices of valid frames must
     either decode cleanly or raise the typed ClusterProtocolError —
     never OverflowError/MemoryError/IndexError/struct.error, and never
@@ -390,10 +391,14 @@ def run_cluster_frame_fuzz(seed, iters):
         OP_RING,
         ClusterProtocolError,
         decode_batch,
+        decode_droute,
+        decode_leave,
         decode_ring,
         decode_route,
         decode_rows,
         encode_batch,
+        encode_droute,
+        encode_leave,
         encode_ring,
         encode_route,
         encode_rows,
@@ -405,6 +410,8 @@ def run_cluster_frame_fuzz(seed, iters):
         "ring": decode_ring,
         "route": decode_route,
         "batch": decode_batch,
+        "droute": decode_droute,
+        "leave": decode_leave,
     }
     done = 0
     for _ in range(iters):
@@ -414,7 +421,8 @@ def run_cluster_frame_fuzz(seed, iters):
                                dtype=np.uint8))
             for _ in range(n)
         ]
-        kind = ("rows", "ring", "route", "batch")[int(rng.integers(4))]
+        kind = ("rows", "ring", "route", "batch", "droute",
+                "leave")[int(rng.integers(6))]
         if kind == "rows":
             op = OP_MIGRATE if rng.random() < 0.5 else OP_REPLICA
             frame = encode_rows(
@@ -427,6 +435,20 @@ def run_cluster_frame_fuzz(seed, iters):
             frame = encode_ring(
                 OP_RING, int(rng.integers(0, 2**32)),
                 rng.random(int(rng.integers(0, 8))).tolist(),
+            )
+        elif kind == "leave":
+            frame = encode_leave(
+                int(rng.integers(0, 256)), int(rng.integers(0, 2**32))
+            )
+        elif kind == "droute":
+            params = [
+                tuple(int(x) for x in rng.integers(-(2**40), 2**40, 4))
+                for _ in keys
+            ]
+            frame = encode_droute(
+                keys, params, int(rng.integers(0, 2**62)),
+                int(rng.integers(0, 8)),
+                rng.integers(-(2**62), 2**62, n),
             )
         else:
             params = [

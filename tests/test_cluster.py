@@ -385,7 +385,10 @@ def test_peer_failure_successor_takes_over(two_nodes):
     a, b = two_nodes
     key_b = key_owned_by(1, "failproc")
     key_a = key_owned_by(0, "okproc")
-    b.terminate()
+    # SIGKILL: this test pins *unplanned* death (SIGTERM now runs the
+    # graceful drain + planned leave, which hands off without a
+    # takeover — that path is pinned in test_cluster_chaos.py).
+    b.kill()
     b.wait(timeout=30)
     # B-owned key via A: decided by A as B's ring successor (no 500).
     results = [throttle_via(HTTP_A, key_b)["allowed"] for _ in range(5)]

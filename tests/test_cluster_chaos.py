@@ -69,7 +69,15 @@ class Node:
     def __init__(self, index, nodes, **kw):
         kw.setdefault("vnodes", 64)
         kw.setdefault("replicate", True)
-        kw.setdefault("io_timeout_s", 60.0)
+        # The reply timeout must stay ABOVE the handoff gate's worst
+        # case or a peer legitimately blocked waiting for an inbound
+        # migrate is falsely declared dead and its range re-decided
+        # from the warm replica (a double count the exactness tests
+        # catch).  Tests that inject a 20x-slowed gate clock stretch
+        # the 4 s gate to 80 real seconds, so give the reply wait 3x
+        # that; genuinely dead nodes refuse connections instantly, so
+        # the long timeout never runs in a healthy teardown.
+        kw.setdefault("io_timeout_s", 240.0)
         kw.setdefault("handoff_timeout_s", 4.0)
         self.index = index
         self.limiter = TpuRateLimiter(capacity=CAP)
@@ -129,6 +137,25 @@ def two_ring_nodes():
                 pass
 
 
+def settle_handoffs(*nodes_, deadline_s=300.0):
+    """Block (real time) until every node's inbound-handoff gate has
+    drained.  `apply_migrate` pops a pending entry whenever the rows
+    land — only a decide thread inside `_wait_handoff` can abandon one
+    at the gate deadline — so polling here instead of deciding makes a
+    join exact no matter how long the joiner's JIT-compiling bulk
+    inserts take on a loaded CI box.  A migrate that never lands
+    (genuinely lost) still fails loudly at `deadline_s`."""
+    t_end = time.monotonic() + deadline_s
+    while time.monotonic() < t_end:
+        if all(not n.cl._pending_from for n in nodes_):
+            return
+        time.sleep(0.01)
+    pytest.fail(
+        "handoff never settled: "
+        + repr([dict(n.cl._pending_from) for n in nodes_])
+    )
+
+
 def oracle_check(oracle, node, keys, burst, count, period, now, ctx):
     """One batch through the cluster vs the scalar oracle, exact."""
     from test_tpu_batch import oracle_batch
@@ -162,21 +189,35 @@ def test_join_under_load_zero_lost_or_double_counted():
 
     ports = free_ports(3)
     nodes = [f"127.0.0.1:{p}" for p in ports]
-    a = Node(0, nodes)
-    b = Node(1, nodes)
+    # The handoff gate measures its deadline on the injectable cluster
+    # clock: slow it 20x so a loaded CI box can never expire the 4 s
+    # gate while the migrate is genuinely in flight (the flake this
+    # replaces), while a genuinely lost handoff still unblocks eventually.
+    t_base = time.monotonic()
+    slow_clock = lambda: t_base + (time.monotonic() - t_base) * 0.05  # noqa: E731
+    a = Node(0, nodes, clock=slow_clock)
+    b = Node(1, nodes, clock=slow_clock)
     c = None
     try:
         a.join_cluster()
         b.join_cluster()
+        settle_handoffs(a, b)
         oracle = RateLimiter(PeriodicStore())
         pool = [f"jn:{i}" for i in range(48)]
         now = T0
         frontends = [a, b]
         for step in range(24):
             if step == 8:
-                # Join under load: node 2 boots and announces.
-                c = Node(2, nodes)
+                # Join under load: node 2 boots and announces (same
+                # slowed gate clock — it is the joiner whose handoff
+                # deadline the flake used to race).  The settle makes
+                # the exactness claim load-proof: the gate clears when
+                # the migrates LAND, not when a decide polls it, so
+                # waiting here cannot mask an abandoned handoff (that
+                # would hang the gate and trip the settle deadline).
+                c = Node(2, nodes, clock=slow_clock)
                 c.join_cluster()
+                settle_handoffs(a, b, c)
                 frontends = [a, b, c]
             via = frontends[step % len(frontends)]
             oracle_check(
@@ -713,10 +754,12 @@ def cluster_view3(port):
 def test_three_node_join_kill_rejoin_acceptance(tmp_path):
     """The end-to-end elastic lifecycle on three real server processes:
     sustained load survives a node join (zero failed requests, ranges
-    migrate) and a node kill (zero failed requests on the replicated
-    range — an exhausted key stays denied through takeover), and the
-    killed node rejoins with the absorbed state migrated back.  This is
-    the CI acceptance gate for the elastic path.
+    migrate) and a node exit via SIGTERM — now the graceful drain +
+    planned leave, with the kill-path takeover as its bounded fallback
+    (zero failed requests on the range either way — an exhausted key
+    stays denied through the handoff), and the departed node rejoins
+    with the state migrated back.  This is the CI acceptance gate for
+    the elastic path.
 
     Record -> replay pass (ISSUE 14): every node runs with the
     full-capture flight recorder armed; after the soak, the three
@@ -789,7 +832,7 @@ def test_three_node_join_kill_rejoin_acceptance(tmp_path):
         view = cluster_view3(HTTP_PORTS[0])
         assert view["mode"] == "ring"
 
-        # ---- KILL with warm replica --------------------------------- #
+        # ---- LEAVE (SIGTERM drain) with warm replica ----------------- #
         hot = next(
             k for k in (f"hotacc:{i}" for i in range(10_000))
             if ring3.owner_of(k.encode()) == 2
@@ -800,14 +843,18 @@ def test_three_node_join_kill_rejoin_acceptance(tmp_path):
                for _ in range(4)]
         assert seq == [True, True, False, False]
         time.sleep(2.0)  # replica pump cadence
+        # SIGTERM now drains gracefully: planned leave (zero-staleness
+        # handoff) with the kill-path takeover as its bounded fallback;
+        # either way the exit must cost zero client-visible failures.
         procs[2].terminate()
         procs[2].wait(timeout=30)
-        # Zero client-visible failures on the dead range, and the
-        # exhausted key STAYS denied — the warm replica carried its TAT.
+        # Zero client-visible failures on the departed range, and the
+        # exhausted key STAYS denied — the leave handoff (or, on the
+        # fallback path, the warm replica) carried its TAT.
         for i in range(3):
             r = throttle3t(HTTP_PORTS[i % 2], hot, burst=2)
             assert r["allowed"] is False, (
-                "takeover lost the replicated state"
+                "node exit lost the handed-off state"
             )
         fresh = next(
             k for k in (f"freshacc:{i}" for i in range(10_000))
@@ -815,7 +862,11 @@ def test_three_node_join_kill_rejoin_acceptance(tmp_path):
         )
         assert throttle3t(HTTP_PORTS[0], fresh, burst=5)["allowed"] is True
         views = [cluster_view3(HTTP_PORTS[i]) for i in range(2)]
-        assert any(v["takeovers"] >= 1 for v in views), views
+        # The survivors observed the exit: a planned leave (the SIGTERM
+        # drain's normal path) or a takeover (its bounded fallback).
+        assert any(
+            v["leaves"] >= 1 or v["takeovers"] >= 1 for v in views
+        ), views
 
         # ---- REJOIN ------------------------------------------------- #
         procs[2] = spawn_node3(2, trace_dirs[2])
@@ -1112,3 +1163,358 @@ def test_replica_push_failure_retries_next_live_successor():
         assert keys == [hot]
     finally:
         cl.close()
+
+# ------------------------------------------------------------------ #
+# Planned leave / rolling restart (PR 17 graceful lifecycle)
+
+
+def test_leave_under_load_exact_differential():
+    """A node leaves mid-stream (planned departure): every decision
+    before, during and after the handoff matches the single-node
+    scalar oracle value-for-value.  The leave path is OP_JOIN run in
+    reverse, so the join test's zero-lost / zero-double-counted
+    contract holds — with zero staleness, unlike the kill path whose
+    replica handoff tolerates the replication lag."""
+    from throttlecrab_tpu.core.rate_limiter import RateLimiter
+    from throttlecrab_tpu.core.store.periodic import PeriodicStore
+
+    ports = free_ports(3)
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+    # Same slowed gate clock as the join test: the receivers' handoff
+    # deadlines must not expire under CI load while the leave stream
+    # is genuinely in flight.
+    t_base = time.monotonic()
+    slow_clock = lambda: t_base + (time.monotonic() - t_base) * 0.05  # noqa: E731
+    a = Node(0, nodes, clock=slow_clock)
+    b = Node(1, nodes, clock=slow_clock)
+    c = Node(2, nodes, clock=slow_clock)
+    try:
+        for n in (a, b, c):
+            n.join_cluster()
+        settle_handoffs(a, b, c)
+        oracle = RateLimiter(PeriodicStore())
+        pool = [f"lv:{i}" for i in range(48)]
+        now = T0
+        frontends = [a, b, c]
+        for step in range(24):
+            if step == 10:
+                # Planned leave under load: B hands its whole table
+                # off and goes lame-duck; A and C keep the stream
+                # exact through the flip (B stays up, so any frontend
+                # racing the announcement still reaches it and B
+                # re-forwards — decisions never fork).  leave() returns
+                # once every range was SENT; settle until the receivers
+                # APPLIED them, so a loaded box can't expire a gate on
+                # rows that are genuinely in flight.
+                assert b.cl.leave(), "leave with live peers must ack"
+                settle_handoffs(a, c)
+                frontends = [a, c]
+            via = frontends[step % len(frontends)]
+            oracle_check(
+                oracle, via, pool, 4, 10, 60, now, f"step{step}"
+            )
+            now += NS // 4
+        # The departing node's state actually moved: receivers
+        # installed its migrated rows, and no handoff gate expired
+        # (an expired gate means the exactness above was luck).
+        assert b.cl.leave_count >= 1
+        assert a.cl.leave_count >= 1 and c.cl.leave_count >= 1
+        assert a.cl.migrated_in + c.cl.migrated_in > 0
+        assert a.cl.handoff_timeouts == 0
+        assert c.cl.handoff_timeouts == 0
+    finally:
+        for n in (a, b, c):
+            try:
+                n.kill()
+            except Exception:
+                pass
+
+
+def test_lame_duck_forwards_not_decides(two_ring_nodes):
+    """After leave() the departed node still answers every request —
+    lame-duck mode forwards to the new owner instead of deciding from
+    its exported (now-authoritative-elsewhere) table."""
+    a, b = two_ring_nodes
+    keys = [f"ld:{i}" for i in range(16)]
+    res = a.cl.rate_limit_batch(keys, 4, 10, 60, 1, T0)
+    assert (res.status == 0).all() and res.allowed.all()
+    assert a.cl.leave(), "leave with a live peer must ack"
+    assert a.cl._lame_duck
+    fwd0 = a.cl.peers[1].forwarded
+    res = a.cl.rate_limit_batch(keys, 4, 10, 60, 1, T0 + NS)
+    assert (res.status == 0).all() and res.allowed.all()
+    # The batch went over the wire: nothing decides locally on a
+    # weight-0 lame duck (forwarded counts forward RPCs).
+    assert a.cl.peers[1].forwarded > fwd0
+    # And the handoff carried the pre-leave TATs: the second hit on a
+    # burst-4 key sees the first one (remaining 2, not a fresh 3).
+    assert (res.remaining == 2).all(), "leave handoff lost state"
+
+
+def test_leave_fault_falls_back_to_kill_path():
+    """Injected `leave` faults break the announcement: leave() reports
+    the partial handoff (returns False) instead of pretending, and the
+    ordinary kill-path takeover still covers the exit — the survivor
+    serves the departed range from its warm replica with zero
+    client-visible failures (bounded staleness, not lost decisions)."""
+    from throttlecrab_tpu.faults import FaultInjector, arm, disarm, parse_spec
+
+    ports = free_ports(2)
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+    a = Node(0, nodes)
+    b = Node(1, nodes)
+    try:
+        a.join_cluster()
+        b.join_cluster()
+        ring = a.cl.ring
+        hot = next(
+            k for k in (f"lf:{i}" for i in range(4000))
+            if ring.owner_of(k.encode()) == 1
+        )
+        now = T0
+        now = exhaust_key(b, hot, now)
+        # Wait for the warm replica so the fallback has state to serve.
+        deadline = time.monotonic() + 5
+        while (
+            time.monotonic() < deadline
+            and hot.encode() not in a.cl.replica_store
+        ):
+            time.sleep(0.1)
+        assert hot.encode() in a.cl.replica_store
+        arm(FaultInjector(parse_spec("leave:persistent"), seed=3))
+        assert b.cl.leave() is False, "broken announce must not ack"
+        disarm()
+        b.kill()
+        # Kill path: the survivor absorbs the range and an exhausted
+        # key STAYS denied (the replica carried its TAT).
+        res = a.cl.rate_limit_batch([hot], 2, 2, 600, 1, now)
+        assert res.status[0] == 0 and not res.allowed[0]
+    finally:
+        disarm()
+        for n in (a, b):
+            try:
+                n.kill()
+            except Exception:
+                pass
+
+
+def test_deadline_shed_differential(two_ring_nodes):
+    """Rows already past their client deadline shed with
+    STATUS_DEADLINE before any device dispatch or forward — and a shed
+    row must NOT consume quota: the batchmates and every later
+    decision match an oracle that never saw the shed requests."""
+    from test_tpu_batch import oracle_batch
+
+    from throttlecrab_tpu.core.rate_limiter import RateLimiter
+    from throttlecrab_tpu.core.store.periodic import PeriodicStore
+    from throttlecrab_tpu.tpu.limiter import STATUS_DEADLINE
+
+    a, b = two_ring_nodes
+    oracle = RateLimiter(PeriodicStore())
+    pool = [f"dl:{i}" for i in range(32)]
+    now = T0
+    oracle_check(oracle, a, pool, 4, 10, 60, now, "warm")
+    now += NS
+    # Half the batch arrives already expired (even rows); the live
+    # half must still decide exactly, locally and across forwards.
+    dl = np.zeros(len(pool), np.int64)
+    dl[::2] = now - 1
+    dl[1::2] = now + 5 * NS
+    res = a.cl.rate_limit_batch(pool, 4, 10, 60, 1, now, deadlines_ns=dl)
+    assert (res.status[::2] == STATUS_DEADLINE).all()
+    assert not res.allowed[::2].any()
+    live_ix = np.arange(1, len(pool), 2)
+    live_keys = [pool[i] for i in live_ix]
+    nl = len(live_keys)
+    exp = oracle_batch(
+        oracle, live_keys,
+        np.full(nl, 4, np.int64), np.full(nl, 10, np.int64),
+        np.full(nl, 60, np.int64), np.ones(nl, np.int64), now,
+    )
+    np.testing.assert_array_equal(res.status[live_ix], exp["status"])
+    np.testing.assert_array_equal(res.allowed[live_ix], exp["allowed"])
+    np.testing.assert_array_equal(
+        res.remaining[live_ix], exp["remaining"]
+    )
+    # The shed rows left no trace: the full pool keeps matching an
+    # oracle that never saw them, from either frontend.
+    now += NS
+    oracle_check(oracle, b, pool, 4, 10, 60, now, "post-shed-b")
+    now += NS
+    oracle_check(oracle, a, pool, 4, 10, 60, now, "post-shed-a")
+
+
+def test_rolling_restart_soak():
+    """Zero-staleness rolling restart: each node in turn leaves
+    (planned handoff), dies, restarts empty and rejoins — under a
+    continuous oracle-pinned stream.  Every decision across all three
+    restart epochs matches the scalar oracle value-for-value, so a
+    full fleet roll costs zero staleness and zero lost decisions."""
+    from throttlecrab_tpu.core.rate_limiter import RateLimiter
+    from throttlecrab_tpu.core.store.periodic import PeriodicStore
+
+    ports = free_ports(3)
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+    t_base = time.monotonic()
+    slow_clock = lambda: t_base + (time.monotonic() - t_base) * 0.05  # noqa: E731
+    ns = [Node(i, nodes, clock=slow_clock) for i in range(3)]
+    try:
+        for n in ns:
+            n.join_cluster()
+        settle_handoffs(*ns)
+        oracle = RateLimiter(PeriodicStore())
+        pool = [f"rr:{i}" for i in range(48)]
+        state = {"now": T0, "step": 0}
+
+        def drive(k_steps):
+            for _ in range(k_steps):
+                live = [n for n in ns if n is not None]
+                via = live[state["step"] % len(live)]
+                oracle_check(
+                    oracle, via, pool, 4, 10, 60, state["now"],
+                    f"step{state['step']}",
+                )
+                state["now"] += NS // 4
+                state["step"] += 1
+
+        drive(3)
+        for victim in range(3):
+            assert ns[victim].cl.leave(), f"node {victim} leave must ack"
+            # The kill below only stays invisible once both survivors
+            # have processed the OP_LEAVE announcement (before that
+            # they would route at a corpse and fail over to replicas —
+            # the kill path, not the one under test here).
+            others = [
+                n for i, n in enumerate(ns)
+                if n is not None and i != victim
+            ]
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not all(
+                victim in n.cl._departed for n in others
+            ):
+                time.sleep(0.05)
+            assert all(victim in n.cl._departed for n in others)
+            settle_handoffs(*others)
+            ns[victim].kill()
+            ns[victim] = None
+            drive(3)
+            ns[victim] = Node(victim, nodes, clock=slow_clock)
+            ns[victim].join_cluster()
+            settle_handoffs(*[n for n in ns if n is not None])
+            drive(3)
+        for n in ns:
+            assert n.cl.handoff_timeouts == 0
+    finally:
+        for n in ns:
+            if n is not None:
+                try:
+                    n.kill()
+                except Exception:
+                    pass
+
+
+def test_cluster_record_replay_planned_leave():
+    """The rolling-restart soak's trace ingredient: a planned leave is
+    captured as a `cluster-leave` event and the ClusterReplayer
+    reconstructs it — the replayed outcome vector matches the recorded
+    one exactly, because the replay runs the same state-preserving
+    handoff the live node did (not the kill path's replica fallback)."""
+    from throttlecrab_tpu.replay.player import (
+        ClusterReplayer,
+        outcome_vector,
+    )
+    from throttlecrab_tpu.replay.recorder import FlightRecorder, arm, disarm
+    from throttlecrab_tpu.replay.trace import Trace
+
+    ports = free_ports(3)
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+    recorder = FlightRecorder(capacity=4096, out_dir="/tmp")
+    arm(recorder)
+    a = Node(0, nodes)
+    b = Node(1, nodes)
+    c = Node(2, nodes)
+    replayer = None
+    try:
+        for n in (a, b, c):
+            n.join_cluster()
+            n.cl.capture = True
+        settle_handoffs(a, b, c)
+        pool = [f"rl:{i}" for i in range(32)]
+        now = T0
+        frontends = [a, b, c]
+        for step in range(6):
+            frontends[step % 3].cl.rate_limit_batch(
+                pool, 4, 10, 60, 1, now
+            )
+            now += NS // 4
+        # Planned leave under load; the lame duck then goes away for
+        # good (burst-4 keys driven past their limit, so any replayed
+        # staleness would flip a deny to an allow).
+        assert b.cl.leave()
+        settle_handoffs(a, c)
+        frontends = [a, c]
+        for step in range(6):
+            frontends[step % 2].cl.rate_limit_batch(
+                pool, 4, 10, 60, 1, now
+            )
+            now += NS // 4
+        b.kill()
+        for step in range(4):
+            frontends[step % 2].cl.rate_limit_batch(
+                pool, 4, 10, 60, 1, now
+            )
+            now += NS // 4
+
+        path, _n = recorder.dump()
+        disarm()
+        trace = Trace.load(path)
+        assert "cluster-leave" in [e.kind for e in trace.events]
+        replayer = ClusterReplayer(3, capacity=CAP)
+        replayed = replayer.replay(trace, settle_s=1.0)
+        assert outcome_vector(replayed) == trace.outcome_vector(), (
+            "replayed planned-leave timeline drifted from the "
+            "recorded outcomes"
+        )
+    finally:
+        disarm()
+        if replayer is not None:
+            replayer.close()
+        for n in (a, b, c):
+            try:
+                n.kill()
+            except Exception:
+                pass
+
+
+def test_leave_and_droute_codecs_roundtrip_and_harden():
+    """The two PR 17 wire frames follow the cluster codec contract:
+    exact roundtrip, and truncated/corrupt bodies raise the typed
+    protocol error instead of mis-decoding."""
+    from throttlecrab_tpu.parallel.cluster import (
+        ClusterProtocolError,
+        _HDR,
+        decode_droute,
+        decode_leave,
+        encode_droute,
+        encode_leave,
+    )
+
+    frame = encode_leave(3, 17)
+    assert decode_leave(frame[_HDR.size:]) == (3, 17)
+    with pytest.raises(ClusterProtocolError):
+        decode_leave(frame[_HDR.size:-1])
+
+    keys = [b"a", b"bb", b"ccc"]
+    params = np.array(
+        [[4, 10, 60, 1], [5, 11, 61, 2], [6, 12, 62, 3]], np.int64
+    )
+    budgets = np.array([7 * NS, 0, 3 * NS], np.int64)
+    frame = encode_droute(keys, params, T0, 2, budgets)
+    hops, k2, p2, now2, b2 = decode_droute(frame[_HDR.size:])
+    assert hops == 2 and k2 == keys and now2 == T0
+    np.testing.assert_array_equal(p2, params)
+    np.testing.assert_array_equal(b2, budgets)
+    # Truncation anywhere in the budget column or batch body raises.
+    for cut in (1, 10, 30):
+        with pytest.raises(ClusterProtocolError):
+            decode_droute(frame[_HDR.size:-cut])

@@ -382,3 +382,87 @@ def test_adaptive_expired_ratio_fires_engine_sweep():
     # The drained count is mirrored into /metrics.
     assert metrics.expired_hits == 60
     assert "throttlecrab_tpu_expired_hits 60" in metrics.export_prometheus()
+
+
+# ------------------------------------------------- drain / deadlines #
+
+
+def test_begin_drain_sheds_new_resolves_queued():
+    """begin_drain() flips lame-duck serving: already-queued requests
+    resolve with real decisions, new arrivals shed with OverloadError
+    ("server draining" — 503, not a failure), and /health reports
+    "draining" so balancers de-route before the listener closes."""
+    from throttlecrab_tpu.server.engine import OverloadError
+
+    async def main():
+        engine, _ = make_engine(batch_size=64, max_linger_us=10_000_000)
+        queued = [
+            asyncio.ensure_future(engine.throttle(req(key=f"q{i}")))
+            for i in range(3)
+        ]
+        await asyncio.sleep(0)  # requests land in the pending list
+        engine.begin_drain()
+        assert engine.health_state() == "draining"
+        with pytest.raises(OverloadError, match="draining"):
+            await engine.throttle(req(key="late"))
+        await engine.drain()
+        results = await asyncio.gather(*queued)
+        return results, engine.drain_shed
+
+    results, shed = run(main())
+    assert all(r.allowed for r in results)
+    assert shed == 1
+
+
+def test_drain_then_shutdown_keeps_shutdown_semantics():
+    """drain() is the graceful half; shutdown() after it must still
+    pin the abrupt contract: health "shutdown" and ThrottleError (not
+    OverloadError) for anything arriving after close."""
+
+    async def main():
+        engine, _ = make_engine(batch_size=64, max_linger_us=10_000_000)
+        pending = asyncio.ensure_future(engine.throttle(req(key="p")))
+        await asyncio.sleep(0)
+        await engine.drain()
+        result = await pending
+        await engine.shutdown()
+        assert engine.health_state() == "shutdown"
+        with pytest.raises(ThrottleError):
+            await engine.throttle(req(key="q"))
+        return result
+
+    assert run(main()).allowed
+
+
+def test_deadline_shed_at_flush_spares_batchmates():
+    """A queued request whose client deadline lapses before the flush
+    sheds with DeadlineError — before any device dispatch — while its
+    batchmates still get real decisions; deadline_default_ms stamps
+    requests that carry no explicit deadline."""
+    from throttlecrab_tpu.server.engine import DeadlineError
+
+    async def main():
+        clock = VirtualClock()
+        engine, _ = make_engine(
+            clock=clock, batch_size=64, max_linger_us=10_000_000,
+            deadline_default_ms=50,
+        )
+        stale_req = req(key="a")
+        stale = asyncio.ensure_future(engine.throttle(stale_req))
+        await asyncio.sleep(0)
+        # The default was stamped at ingest (absolute, engine clock).
+        assert stale_req.deadline_ns == clock.now + 50 * 1_000_000
+        clock.now += 100 * 1_000_000  # lapse it in-queue
+        fresh_req = req(key="b")
+        fresh_req.deadline_ns = clock.now + 1_000_000_000  # still live
+        fresh = asyncio.ensure_future(engine.throttle(fresh_req))
+        await asyncio.sleep(0)
+        await engine.drain()  # flush everything queued
+        with pytest.raises(DeadlineError, match="deadline exceeded"):
+            await stale
+        response = await fresh
+        return response, engine.deadline_shed
+
+    response, shed = run(main())
+    assert response.allowed
+    assert shed == 1

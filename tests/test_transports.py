@@ -396,3 +396,174 @@ def test_stop_with_open_connections_returns_promptly():
             w.close()
 
     asyncio.run(main())
+
+
+# ------------------------------------------------- client deadlines #
+
+
+class _Clock:
+    def __init__(self, start=T0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+def make_deadline_stack():
+    """batch_size=2 + huge linger: the deadline-carrying request parks
+    in the queue until a second one fills the batch, so the test —
+    not the scheduler — decides what the flush-time clock reads."""
+    metrics = Metrics(max_denied_keys=10)
+    limiter = TpuRateLimiter(capacity=1024)
+    clock = _Clock()
+    engine = BatchingEngine(
+        limiter, batch_size=2, max_linger_us=10_000_000, now_fn=clock
+    )
+    return engine, metrics, clock
+
+
+def test_http_deadline_header_sheds_504():
+    """`X-Throttlecrab-Deadline-Ms` stamps a client deadline; a request
+    still queued past it answers 504 while its batchmate — flushed in
+    the same window — still gets a real decision."""
+
+    async def main():
+        engine, metrics, clock = make_deadline_stack()
+        transport = HttpTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+
+        async def with_deadline():
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port
+            )
+            payload = json.dumps(
+                {"key": "dl", "max_burst": 3, "count_per_period": 10,
+                 "period": 60}
+            ).encode()
+            writer.write((
+                "POST /throttle HTTP/1.1\r\nHost: x\r\n"
+                "X-Throttlecrab-Deadline-Ms: 5\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode() + payload)
+            await writer.drain()
+            raw = await reader.read(-1)
+            writer.close()
+            head, _, body = raw.partition(b"\r\n\r\n")
+            return int(head.split(b" ", 2)[1]), body
+
+        t1 = asyncio.create_task(with_deadline())
+        # Let it enqueue, then lapse its 5 ms budget on the virtual
+        # clock before the batch-filling second request flushes.
+        await asyncio.sleep(0.1)
+        clock.now += 10 * 1_000_000
+        status2, raw2 = await http_request(
+            port, "POST", "/throttle",
+            {"key": "dl2", "max_burst": 3, "count_per_period": 10,
+             "period": 60},
+        )
+        status1, raw1 = await t1
+        await transport.stop()
+        return status1, raw1, status2, raw2, engine.deadline_shed
+
+    status1, raw1, status2, raw2, shed = asyncio.run(main())
+    assert status1 == 504
+    assert b"deadline exceeded" in raw1
+    assert status2 == 200 and json.loads(raw2)["allowed"]
+    assert shed == 1
+
+
+def test_redis_deadline_token_sheds_err():
+    """THROTTLE's optional 7th token is a deadline in ms: an invalid
+    one answers -ERR immediately; a lapsed one sheds the queued request
+    with -ERR deadline exceeded (single RESP error channel)."""
+
+    async def main():
+        engine, metrics, clock = make_deadline_stack()
+        transport = RedisTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+        r1, w1 = await asyncio.open_connection("127.0.0.1", port)
+        r2, w2 = await asyncio.open_connection("127.0.0.1", port)
+
+        out = await resp_command(
+            r1, w1, "THROTTLE", "dk", "3", "10", "60", "1", "abc"
+        )
+        assert out == b"-ERR invalid deadline_ms\r\n"
+
+        t1 = asyncio.create_task(
+            resp_command(
+                r1, w1, "THROTTLE", "dk", "3", "10", "60", "1", "5"
+            )
+        )
+        await asyncio.sleep(0.1)
+        clock.now += 10 * 1_000_000
+        out2 = await resp_command(r2, w2, "THROTTLE", "dk2", "3", "10",
+                                  "60")
+        out1 = await t1
+        for w in (w1, w2):
+            w.close()
+        await transport.stop()
+        return out1, out2, engine.deadline_shed
+
+    out1, out2, shed = asyncio.run(main())
+    assert out1 == b"-ERR deadline exceeded\r\n"
+    assert out2.startswith(b"*5\r\n:1\r\n")
+    assert shed == 1
+
+
+def test_grpc_native_deadline_sheds_deadline_exceeded():
+    """gRPC carries deadlines natively: the call's remaining budget
+    maps onto the engine deadline, so a request whose budget lapses
+    in-queue is shed host-side with DEADLINE_EXCEEDED instead of
+    spending a device launch on an abandoned call."""
+    import grpc
+    import grpc.aio
+
+    from throttlecrab_tpu.server.grpc import GrpcTransport
+    from throttlecrab_tpu.server.proto import throttlecrab_pb2 as pb
+
+    async def main():
+        engine, metrics, clock = make_deadline_stack()
+        transport = GrpcTransport("127.0.0.1", 0, engine, metrics)
+        await transport.start()
+        port = transport.bound_port
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{port}"
+        ) as channel:
+            method = channel.unary_unary(
+                "/throttlecrab.RateLimiter/Throttle",
+                request_serializer=pb.ThrottleRequest.SerializeToString,
+                response_deserializer=pb.ThrottleResponse.FromString,
+            )
+            # 30 s real-time budget: far more than the test needs, so
+            # the DEADLINE_EXCEEDED below can only come from the
+            # engine's virtual-clock shed, not the client timer.
+            t1 = asyncio.ensure_future(method(
+                pb.ThrottleRequest(
+                    key="gd", max_burst=3, count_per_period=10,
+                    period=60, quantity=1,
+                ),
+                timeout=30.0,
+            ))
+            await asyncio.sleep(0.2)
+            clock.now += 60 * 1_000_000_000
+            ok = await method(
+                pb.ThrottleRequest(
+                    key="gd2", max_burst=3, count_per_period=10,
+                    period=60, quantity=1,
+                )
+            )
+            code = None
+            try:
+                await t1
+            except grpc.aio.AioRpcError as e:
+                code = e.code()
+        await transport.stop()
+        return code, ok.allowed, engine.deadline_shed
+
+    code, ok, shed = asyncio.run(main())
+    assert code == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert ok
+    assert shed == 1

@@ -13,9 +13,11 @@ from .injector import (  # noqa: F401  (re-exported API)
     FaultInjector,
     FaultSpec,
     InjectedDeviceError,
+    PartialWriteError,
     active_injector,
     arm,
     disarm,
     maybe_fail,
     parse_spec,
+    send_with_faults,
 )

@@ -13,6 +13,8 @@ points threaded through the real failure surfaces —
   * ``snapshot`` — snapshot file I/O fails,
   * ``migrate``  — a cluster key-range migration (send or apply side)
     fails mid-handoff — the elastic ring's hardest window,
+  * ``leave``    — a planned departure (announce or receive side) fails
+    mid-handoff — graceful drain degrading to the kill path,
 
 each raising the same exception *shape* the real system produces at that
 surface (an ``UNAVAILABLE``-prefixed runtime error for the device
@@ -20,6 +22,13 @@ surfaces — the string PJRT puts on a lost TPU, and exactly what the
 launch supervisor's classifier keys on; ``ConnectionError`` for peer
 sockets; ``InternalError("bucket table full")`` for the keymap;
 ``OSError`` for snapshot I/O).
+
+Socket realism: beyond clean raises, the ``slow`` mode stalls a socket
+operation (a congested/slow peer) and then lets it proceed, and the
+``partial`` mode — at sender chokepoints routed through
+:func:`send_with_faults` — writes a *prefix* of the frame before
+failing, so the receiver observes a genuinely truncated frame and must
+drop the connection to resynchronize.
 
 Determinism: probability draws come from a per-fault 64-bit LCG seeded
 from the spec, never from ``random``/wall clock, so a chaos run replays
@@ -39,8 +48,10 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
-SITES = ("launch", "fetch", "peer", "keymap", "snapshot", "migrate")
-MODES = ("transient", "persistent", "count", "hang")
+SITES = (
+    "launch", "fetch", "peer", "keymap", "snapshot", "migrate", "leave",
+)
+MODES = ("transient", "persistent", "count", "hang", "slow", "partial")
 
 
 class InjectedDeviceError(RuntimeError):
@@ -54,12 +65,22 @@ class InjectedDeviceError(RuntimeError):
     """
 
 
+class PartialWriteError(ConnectionError):
+    """A fired ``partial`` socket mode.
+
+    A ConnectionError subclass so sites that only ``maybe_fail`` (no
+    frame to truncate, e.g. the receive side) degrade to a clean
+    connection failure; :func:`send_with_faults` catches it at sender
+    chokepoints to actually truncate the frame on the wire first.
+    """
+
+
 def _site_error(site: str, detail: str) -> Exception:
     if site in ("launch", "fetch"):
         return InjectedDeviceError(
             f"UNAVAILABLE: injected {site} fault ({detail})"
         )
-    if site in ("peer", "migrate"):
+    if site in ("peer", "migrate", "leave"):
         return ConnectionError(
             f"injected {site} socket fault ({detail})"
         )
@@ -86,7 +107,10 @@ def parse_spec(text: str) -> List[FaultSpec]:
     Modes: ``transient:p`` (each check fails with probability p),
     ``persistent`` (every check fails until healed), ``count:n`` (the
     next n checks fail, then pass — scripts an outage-then-recovery),
-    ``hang:seconds`` (the check stalls, then passes).
+    ``hang:seconds`` (the check stalls, then passes), ``slow:seconds``
+    (socket sites: the operation stalls like a congested peer, then
+    proceeds), ``partial`` (socket sender sites: a prefix of the frame
+    reaches the wire before the connection fails).
     """
     specs: List[FaultSpec] = []
     for raw in text.split(","):
@@ -111,11 +135,11 @@ def parse_spec(text: str) -> List[FaultSpec]:
                 arg = float(parts[2])
             except ValueError as e:
                 raise ValueError(f"bad fault arg in {raw!r}: {e}") from e
-        elif mode in ("transient", "count", "hang"):
+        elif mode in ("transient", "count", "hang", "slow"):
             raise ValueError(f"fault mode {mode!r} requires an arg")
         if mode == "transient" and not 0.0 <= arg <= 1.0:
             raise ValueError("transient probability must be in [0, 1]")
-        if mode in ("count", "hang") and arg < 0:
+        if mode in ("count", "hang", "slow") and arg < 0:
             raise ValueError(f"fault arg must be >= 0 in {raw!r}")
         specs.append(FaultSpec(site, mode, arg))
     return specs
@@ -174,6 +198,18 @@ class _Armed:
             self.fired += 1
             note_fired(spec.site, spec.mode, index, spec.arg)
             sleep_fn(spec.arg)
+        elif spec.mode == "slow":
+            # A congested peer: the operation stalls, then succeeds.
+            self.fired += 1
+            note_fired(spec.site, spec.mode, index, spec.arg)
+            sleep_fn(spec.arg)
+        elif spec.mode == "partial":
+            self.fired += 1
+            note_fired(spec.site, spec.mode, index, spec.arg)
+            raise PartialWriteError(
+                f"injected {spec.site} partial write (connection lost "
+                "mid-frame)"
+            )
 
 
 class FaultInjector:
@@ -275,11 +311,13 @@ class FaultInjector:
                 return
             for mode, arg in hits:
                 self._note_fired(site, mode, index, arg)
-        # Recorded order == live armed order: hangs stalled first, and
-        # the firing that raised ended the live check — replay the
-        # stalls, then re-raise the (single possible) raising mode.
+        # Recorded order == live armed order: hangs/slows stalled
+        # first, and the firing that raised ended the live check —
+        # replay the stalls, then re-raise the (single possible)
+        # raising mode.  `partial` replays as its clean ConnectionError
+        # shape (replay has no socket to truncate).
         for mode, arg in hits:
-            if mode == "hang":
+            if mode in ("hang", "slow"):
                 self._sleep(arg)
             else:
                 raise _site_error(
@@ -308,6 +346,25 @@ def active_injector() -> Optional[FaultInjector]:
 
 
 def maybe_fail(site: str) -> None:
-    """The hook the five failure surfaces call; no-op unless armed."""
+    """The hook the failure surfaces call; no-op unless armed."""
     if _active is not None:
         _active.check(site)
+
+
+def send_with_faults(site: str, sock, frame: bytes) -> None:
+    """Socket-send chokepoint: checks `site` like maybe_fail, then
+    writes `frame` — but a fired ``partial`` mode puts a prefix of the
+    frame on the wire and kills the connection first, so the receiver
+    sees a genuinely truncated frame (not a clean error) and must drop
+    the connection to resynchronize its frame stream."""
+    if _active is not None:
+        try:
+            _active.check(site)
+        except PartialWriteError:
+            try:
+                sock.sendall(frame[: max(1, len(frame) // 2)])
+                sock.close()
+            except OSError:
+                pass
+            raise
+    sock.sendall(frame)

@@ -23,6 +23,12 @@ from typing import List
 
 from .workload import Workload, flash_crowd_hot_sets, make_keys
 
+#: Sentinel outcome a client returns when the server shed the request
+#: for a lapsed deadline (HTTP 504 / RESP -ERR deadline exceeded /
+#: gRPC DEADLINE_EXCEEDED) — counted separately from errors: a miss is
+#: the deadline feature working, not the server failing.
+DEADLINE_MISS = object()
+
 
 @dataclass
 class StatsProbe:
@@ -111,6 +117,13 @@ class PerfResult:
     _consecutive_errors: int = field(default=0, repr=False)
     first_error_s: float = -1.0
     last_recovery_s: float = -1.0
+    # Requests the server shed for a lapsed deadline (--deadline-ms).
+    deadline_misses: int = 0
+    # Longest gap between any two successful responses across the whole
+    # client fleet — the client-observed availability stall; a rolling
+    # restart passes when this stays near the normal response cadence.
+    max_stall_s: float = 0.0
+    _last_ok_t: float = field(default=-1.0, repr=False)
     # GET /stats polling results (--stats; a StatsProbe or None).
     stats_probe: object = field(default=None, repr=False)
     # Per-tenant [allowed, denied, errors] splits, keyed by the tenant
@@ -149,6 +162,17 @@ class PerfResult:
             }
         return out
 
+    def track_stall(self, t_s: float, ok: bool) -> None:
+        """Feed per-request completion times (any worker): a success
+        closes the current availability gap, and the longest gap is the
+        run's max stall."""
+        if ok:
+            if self._last_ok_t >= 0:
+                self.max_stall_s = max(
+                    self.max_stall_s, t_s - self._last_ok_t
+                )
+            self._last_ok_t = t_s
+
     def track_outcome(self, is_error: bool, t_s: float) -> None:
         """Feed per-request outcomes (in completion order) for the
         chaos stats: longest error run and the last error→success
@@ -176,6 +200,8 @@ class PerfResult:
             "recovered": (
                 self.errors == 0 or self.last_recovery_s >= 0
             ),
+            "max_stall_s": round(self.max_stall_s, 3),
+            "deadline_misses": self.deadline_misses,
         }
 
     @property
@@ -227,6 +253,8 @@ class PerfResult:
             "p90_ms": round(self.percentile_ms(0.90), 3),
             "p99_ms": round(self.percentile_ms(0.99), 3),
             "p99_9_ms": round(self.percentile_ms(0.999), 3),
+            "deadline_misses": self.deadline_misses,
+            "max_stall_s": round(self.max_stall_s, 3),
             # The control plane's multi-objective yardstick (L3.9):
             # comparable across live runs, bench A/Bs, and offline
             # `control rank` output.
@@ -274,7 +302,7 @@ class HttpClient:
 
     async def throttle(
         self, key: str, burst: int, count: int, period: int,
-        quantity: int = 1,
+        quantity: int = 1, deadline_ms: int = 0,
     ):
         body = json.dumps(
             {
@@ -285,9 +313,13 @@ class HttpClient:
                 "quantity": quantity,
             }
         ).encode()
+        deadline_hdr = (
+            b"X-Throttlecrab-Deadline-Ms: %d\r\n" % deadline_ms
+            if deadline_ms > 0 else b""
+        )
         self.writer.write(
             b"POST /throttle HTTP/1.1\r\nHost: x\r\n"
-            b"Content-Type: application/json\r\n"
+            b"Content-Type: application/json\r\n" + deadline_hdr +
             b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
         )
         await self.writer.drain()
@@ -298,6 +330,8 @@ class HttpClient:
             if line.lower().startswith(b"content-length:"):
                 length = int(line.split(b":", 1)[1])
         payload = await self.reader.readexactly(length)
+        if status == 504:
+            return DEADLINE_MISS
         if status != 200:
             return None
         return json.loads(payload)["allowed"]
@@ -327,11 +361,14 @@ class RedisClient:
 
     @staticmethod
     def _frame(
-        key: str, burst: int, count: int, period: int, quantity: int = 1
+        key: str, burst: int, count: int, period: int, quantity: int = 1,
+        deadline_ms: int = 0,
     ) -> bytes:
         parts = [b"THROTTLE", key.encode(), str(burst).encode(),
                  str(count).encode(), str(period).encode(),
                  str(quantity).encode()]
+        if deadline_ms > 0:
+            parts.append(str(deadline_ms).encode())
         return b"*%d\r\n" % len(parts) + b"".join(
             b"$%d\r\n%s\r\n" % (len(p), p) for p in parts
         )
@@ -348,9 +385,12 @@ class RedisClient:
         return line
 
     async def _read_response(self):
-        """One RESP response: *5 int array → allowed bool; -ERR → None."""
+        """One RESP response: *5 int array → allowed bool; -ERR → None
+        (a deadline shed maps to the DEADLINE_MISS sentinel)."""
         line = await self._readline()
         if line.startswith(b"-"):
+            if line.startswith(b"-ERR deadline"):
+                return DEADLINE_MISS
             return None
         if line.startswith(b"*"):
             n = int(line[1:])
@@ -360,9 +400,11 @@ class RedisClient:
 
     async def throttle(
         self, key: str, burst: int, count: int, period: int,
-        quantity: int = 1,
+        quantity: int = 1, deadline_ms: int = 0,
     ):
-        self.writer.write(self._frame(key, burst, count, period, quantity))
+        self.writer.write(
+            self._frame(key, burst, count, period, quantity, deadline_ms)
+        )
         await self.writer.drain()
         return await self._read_response()
 
@@ -451,14 +493,25 @@ class GrpcClient:
 
     async def throttle(
         self, key: str, burst: int, count: int, period: int,
-        quantity: int = 1,
+        quantity: int = 1, deadline_ms: int = 0,
     ):
-        response = await self.method(
-            self._pb.ThrottleRequest(
-                key=key, max_burst=burst, count_per_period=count,
-                period=period, quantity=quantity,
-            )
+        import grpc
+
+        call_kw = (
+            {"timeout": deadline_ms / 1000.0} if deadline_ms > 0 else {}
         )
+        try:
+            response = await self.method(
+                self._pb.ThrottleRequest(
+                    key=key, max_burst=burst, count_per_period=count,
+                    period=period, quantity=quantity,
+                ),
+                **call_kw,
+            )
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                return DEADLINE_MISS
+            raise
         return response.allowed
 
     async def close(self) -> None:
@@ -491,6 +544,7 @@ async def run_perf_test(
     seed: int = 0,
     record_path: str = "",
     replay_path: str = "",
+    deadline_ms: int = 0,
 ) -> PerfResult:
     """Barrier-synchronized workers, pre-generated keys
     (perf_test_multi_transport.rs:48-127).
@@ -513,6 +567,8 @@ async def run_perf_test(
         raise ValueError("--pipeline requires the redis transport")
     if pipeline > 1 and (record_path or replay_path):
         raise ValueError("--record/--replay require --pipeline 1")
+    if pipeline > 1 and deadline_ms > 0:
+        raise ValueError("--deadline-ms requires --pipeline 1")
 
     # Per-worker schedules of (key, burst, count, period, quantity).
     if replay_path:
@@ -565,6 +621,14 @@ async def run_perf_test(
     track_tenants = key_pattern == "noisy-neighbor"
 
     def tally(allowed, key=None) -> None:
+        t_s = time.perf_counter() - t_start
+        if allowed is DEADLINE_MISS:
+            # The deadline feature working as designed — tracked apart
+            # from errors so a shed never masks a real failure (and
+            # never counts as chaos-recovery "success" either).
+            result.deadline_misses += 1
+            return
+        result.track_stall(t_s, allowed is not None)
         if allowed is None:
             result.errors += 1
         elif allowed:
@@ -574,9 +638,7 @@ async def run_perf_test(
         if track_tenants and key is not None:
             result.track_tenant(key, allowed)
         if chaos:
-            result.track_outcome(
-                allowed is None, time.perf_counter() - t_start
-            )
+            result.track_outcome(allowed is None, t_s)
 
     def tally_errors(n: int) -> None:
         result.errors += n
@@ -628,7 +690,9 @@ async def run_perf_test(
                 await asyncio.sleep(delay)
             t0 = time.perf_counter()
             try:
-                allowed = await client.throttle(key, kb, kc, kp, kq)
+                allowed = await client.throttle(
+                    key, kb, kc, kp, kq, deadline_ms=deadline_ms
+                )
             except Exception:
                 tally_errors(1)
                 if record is not None:
@@ -647,9 +711,11 @@ async def run_perf_test(
                 continue
             result.latencies_s.append(time.perf_counter() - t0)
             if record is not None:
-                record.append(
-                    (key, kb, kc, kp, kq, allowed, time.time_ns())
-                )
+                record.append((
+                    key, kb, kc, kp, kq,
+                    None if allowed is DEADLINE_MISS else allowed,
+                    time.time_ns(),
+                ))
             tally(allowed, key)
 
     t_start = time.perf_counter()
@@ -708,7 +774,7 @@ def main(argv=None) -> int:
                    choices=["sequential", "random", "zipfian",
                             "user-resource", "hotkey-abuse",
                             "flash-crowd", "chaos", "noisy-neighbor",
-                            "diurnal", "slow-drift"])
+                            "diurnal", "slow-drift", "rolling-restart"])
     p.add_argument("--stats", action="store_true",
                    help="poll GET /stats (the insight tier) every "
                         "200 ms during the run and report hot-key "
@@ -754,6 +820,11 @@ def main(argv=None) -> int:
     p.add_argument("--burst", type=int, default=100)
     p.add_argument("--count", type=int, default=10_000)
     p.add_argument("--period", type=int, default=60)
+    p.add_argument("--deadline-ms", type=int, default=0,
+                   help="per-request deadline in milliseconds (HTTP "
+                        "header / RESP 7th token / native gRPC "
+                        "deadline); server-shed requests are reported "
+                        "as deadline_misses, apart from errors")
     args = ap.parse_args(argv)
 
     transports = (
@@ -790,7 +861,7 @@ def main(argv=None) -> int:
             pipeline=args.pipeline, chaos=args.chaos,
             stats_port=(args.stats_port or args.port) if args.stats else 0,
             seed=args.seed, record_path=args.record,
-            replay_path=args.replay,
+            replay_path=args.replay, deadline_ms=args.deadline_ms,
         )
         if args.procs > 1:
             result = run_multiproc(
@@ -830,7 +901,8 @@ def _proc_entry(transport, host, port, workers, requests, kwargs):
         result.total_requests, result.elapsed_s, result.allowed,
         result.denied, result.errors, result.latencies_s,
         result.max_consecutive_errors, result.first_error_s,
-        result.last_recovery_s,
+        result.last_recovery_s, result.deadline_misses,
+        result.max_stall_s,
     )
 
 
@@ -869,7 +941,7 @@ def run_multiproc(
         key_pattern=kwargs.get("key_pattern", "random"),
     )
     for (total, elapsed, allowed, denied, errors, lats,
-         max_consec, first_err, last_rec) in parts:
+         max_consec, first_err, last_rec, dl_misses, max_stall) in parts:
         merged.total_requests += total
         merged.elapsed_s = max(merged.elapsed_s, elapsed)
         merged.allowed += allowed
@@ -884,6 +956,10 @@ def run_multiproc(
         ):
             merged.first_error_s = first_err
         merged.last_recovery_s = max(merged.last_recovery_s, last_rec)
+        merged.deadline_misses += dl_misses
+        # Per-process stalls only (cross-process response interleaving
+        # is unobservable here); the max is still the fleet's worst.
+        merged.max_stall_s = max(merged.max_stall_s, max_stall)
     return merged
 
 

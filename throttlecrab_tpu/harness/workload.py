@@ -178,6 +178,19 @@ def make_keys(
         churn = key_space + (seed + 1) * n_requests + np.arange(n_requests)
         u = rng.random(n_requests)
         ids = np.where(u < 0.5, hot, np.where(u < 0.9, cold, churn))
+    elif pattern == "rolling-restart":
+        # Companion for the rolling-restart soak: a FIXED key
+        # population (no churn band) whose buckets stay live for the
+        # whole run, so every node restart must carry their state
+        # across the handoff — a hot band driven past its limit (any
+        # post-handoff staleness shows up immediately as an extra
+        # allow vs the oracle) over a uniform warm tail that keeps
+        # every ring range populated with migrate-worthy state.
+        n_hot = max(key_space // 100, 1)
+        hot = rng.integers(0, n_hot, n_requests)
+        warm = rng.integers(n_hot, max(key_space, n_hot + 1), n_requests)
+        is_hot = rng.random(n_requests) < 0.3
+        ids = np.where(is_hot, hot, warm)
     else:
         raise ValueError(f"unknown key pattern: {pattern!r}")
     return [f"key:{i}" for i in ids]

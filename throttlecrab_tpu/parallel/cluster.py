@@ -49,6 +49,9 @@ frozen legacy pair, the rest are ring-mode only):
   ring (5),
   ring_state (8): u32 epoch | u8 n | n x u16 milliweight  (no reply)
   join (7):     u8 origin                        -> ring_state (8)
+  leave (11):   u8 origin | u32 epoch             (no reply)
+  droute (12):  u8 hops | u32 n | n x i64 budget_ns | <batch body>
+                                                 -> reply (2)
 
 Failure isolation: in legacy mode a dead peer fails only the requests
 routed to it (STATUS_INTERNAL per request); in ring mode those requests
@@ -68,10 +71,11 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..faults import maybe_fail
+from ..faults import maybe_fail, send_with_faults
 from ..tpu.limiter import (
     BatchResult,
     _ReadyLaunch,
+    STATUS_DEADLINE,
     STATUS_INTERNAL,
     STATUS_INVALID_PARAMS,
     ScalarCompatMixin,
@@ -97,6 +101,8 @@ OP_JOIN = 7           # membership (re-)announcement -> OP_RING_STATE
 OP_RING_STATE = 8     # reply to OP_JOIN: epoch + weight vector
 OP_REPLICA = 9        # warm-standby async state deltas (best-effort)
 OP_ROUTE_BATCH = 10   # ownership-checked batch (hop-counted)
+OP_LEAVE = 11         # planned departure announcement (no reply)
+OP_DROUTE_BATCH = 12  # route batch carrying per-row deadline budgets
 
 #: Forward-chain bound for OP_ROUTE_BATCH: membership skew is resolved
 #: by each receiver re-checking ownership and forwarding onward; at the
@@ -112,6 +118,8 @@ _ROW_STATE = struct.Struct("<qq")    # tat_ns, expiry_ns
 _RING_HEAD = struct.Struct("<IB")    # epoch, n_nodes (then u16 milliweights)
 _JOIN_BODY = struct.Struct("<B")     # origin index
 _ROUTE_HEAD = struct.Struct("<B")    # hops (then the OP_THROTTLE_BATCH body)
+_LEAVE_BODY = struct.Struct("<BI")   # origin index, epoch
+_DROUTE_HEAD = struct.Struct("<BI")  # hops, n (then n x i64 budgets + body)
 # Reply items as a numpy structured dtype: fixed-stride, so whole batches
 # encode/decode in one vectorized call instead of per-item struct loops.
 _REP_DTYPE = np.dtype(
@@ -327,6 +335,50 @@ def decode_join(body: bytes) -> int:
     return _JOIN_BODY.unpack(body)[0]
 
 
+def encode_leave(origin: int, epoch: int) -> bytes:
+    body = _LEAVE_BODY.pack(origin, epoch)
+    return _HDR.pack(len(body), OP_LEAVE) + body
+
+
+def decode_leave(body: bytes) -> Tuple[int, int]:
+    if len(body) != _LEAVE_BODY.size:
+        raise ClusterProtocolError("bad leave frame size")
+    return _LEAVE_BODY.unpack(body)
+
+
+def encode_droute(
+    keys: Sequence[bytes], params, now_ns: int, hops: int, budgets_ns
+) -> bytes:
+    """OP_ROUTE_BATCH plus a per-row deadline column: the remaining
+    client budget in ns at send time (0 = no deadline).  Emitted ONLY
+    when some row actually carries a deadline — batches without one
+    stay on the classic route op, byte-identical to before."""
+    body = (
+        _DROUTE_HEAD.pack(hops, len(keys))
+        + np.asarray(budgets_ns, np.int64).astype("<i8").tobytes()
+        + _batch_body(keys, params, now_ns)
+    )
+    return _HDR.pack(len(body), OP_DROUTE_BATCH) + body
+
+
+def decode_droute(body: bytes):
+    """-> (hops, keys, params, now_ns, budgets_ns i64[n]);
+    bounds-checked like decode_batch."""
+    if len(body) < _DROUTE_HEAD.size:
+        raise ClusterProtocolError("short droute frame")
+    hops, n = _DROUTE_HEAD.unpack_from(body, 0)
+    if n > (len(body) - _DROUTE_HEAD.size) // 8:
+        raise ClusterProtocolError(f"droute count {n} exceeds frame size")
+    off = _DROUTE_HEAD.size
+    budgets = np.frombuffer(body, "<i8", count=n, offset=off).astype(
+        np.int64
+    )
+    keys, params, now_ns = decode_batch(body[off + 8 * n :])
+    if len(keys) != n:
+        raise ClusterProtocolError("droute count mismatches batch")
+    return hops, keys, params, now_ns, budgets
+
+
 class PeerUnavailable(ConnectionError):
     """Raised without touching the network: the peer's circuit is open or
     its reconnect backoff has not elapsed.  A hung or flapping peer must
@@ -480,8 +532,9 @@ class PeerConnection:
                 self._sock = None
 
     def send_frame(self, frame: bytes) -> None:
-        maybe_fail("peer")
-        self._connect().sendall(frame)
+        # Routed through the sender chokepoint so a `partial` fault can
+        # truncate the frame on the wire, not just raise cleanly.
+        send_with_faults("peer", self._connect(), frame)
 
     def recv_frame(self) -> Tuple[int, bytes]:
         maybe_fail("peer")
@@ -547,7 +600,12 @@ class ClusterLimiter(ScalarCompatMixin):
       A node whose device degrades announces a reduced ring weight
       (OP_RING) and migrates the lost vnode ranges out, so a host-
       oracle node serves a proportionally smaller range instead of
-      device-scale traffic.
+      device-scale traffic.  **leave** (the drain path) runs join in
+      reverse: OP_LEAVE announces the departure, the whole local table
+      streams out as OP_MIGRATE rows, and the node serves on as a
+      lame-duck forwarder until shutdown — a planned exit loses zero
+      decisions and zero replica freshness (see ARCHITECTURE.md
+      "Lifecycle").
     """
 
     def __init__(
@@ -563,6 +621,7 @@ class ClusterLimiter(ScalarCompatMixin):
         replicate: bool = False,
         handoff_timeout_s: float = 5.0,
         replica_cap: int = 100_000,
+        clock=None,
     ) -> None:
         """`nodes` lists every node's cluster RPC address host:port (the
         same list, in the same order, on every node); `self_index` is this
@@ -573,9 +632,14 @@ class ClusterLimiter(ScalarCompatMixin):
         arms warm-standby replication to ring successors (ring mode
         only).  For per-peer observability, point the server's Metrics
         at `peer_stats` via set_cluster_stats_provider (run_server
-        does)."""
+        does).  `clock` (monotonic seconds, default time.monotonic)
+        drives the handoff-deadline gate — tests inject a virtual clock
+        so the gate cannot expire spuriously under CI load."""
+        import time
+
         if not 0 <= self_index < len(nodes):
             raise ValueError("self_index out of range")
+        self._clock = clock or time.monotonic
         self.local = local
         self.nodes = list(nodes)
         self.self_index = self_index
@@ -632,11 +696,27 @@ class ClusterLimiter(ScalarCompatMixin):
         #: coldest entry (re-replication refreshes recency).
         self.replica_store: dict = {}
         self._replica_mu = threading.Lock()
+        # ---- planned-leave lifecycle (ring mode) ---------------------- #
+        #: Lame duck: this node announced OP_LEAVE — its ring weight is
+        #: 0 (every key forwards; nothing decides locally), replication
+        #: and reweight broadcasts stop, and the pump's heal probes are
+        #: inert.  Set under _mu, read lock-free on hot paths (benign:
+        #: the ring flip it rides is what actually reroutes keys).
+        self._lame_duck = False
+        #: Peers that announced OP_LEAVE: weight pinned to 0 against
+        #: stale ring echoes, heal probes skip them.  A later OP_JOIN
+        #: re-registers the node.  Guarded by _mu.
+        self._departed: set = set()
+        #: Set once this node's own leave handoff is fully streamed —
+        #: lame-duck forwards park on it so no forward can overtake the
+        #: OP_LEAVE/OP_MIGRATE frames on a peer connection.
+        self._leave_complete = threading.Event()
         # Diagnostics (peer_stats / cluster_view / metrics).
         self.migrated_in = 0
         self.takeover_count = 0
         self.replica_drops = 0
         self.handoff_timeouts = 0
+        self.leave_count = 0  # OP_LEAVE events seen (ours + peers')
         #: Monotonic deadline while weight announcements keep
         #: re-broadcasting (covers a lost OP_RING around EITHER
         #: transition — reduce or restore — and a restart whose peers
@@ -675,6 +755,8 @@ class ClusterLimiter(ScalarCompatMixin):
         with self._mu:
             pending = sorted(self.nodes[d] for d in self._pending_from)
             absorbed = sorted(self.nodes[d] for d in self._absorbed)
+            departed = sorted(self.nodes[d] for d in self._departed)
+            lame_duck = self._lame_duck
             weights = (
                 self.ring.weight_vector() if self.ring is not None else []
             )
@@ -693,6 +775,9 @@ class ClusterLimiter(ScalarCompatMixin):
             "takeovers": self.takeover_count,
             "migrated_in": self.migrated_in,
             "handoff_timeouts": self.handoff_timeouts,
+            "leaves": self.leave_count,
+            "lame_duck": lame_duck,
+            "departed": departed,
             "pending_handoffs": pending,
             "absorbed": absorbed,
             "peers": self.peer_stats(),
@@ -845,10 +930,20 @@ class ClusterLimiter(ScalarCompatMixin):
             reset_after[ix] = res.reset_after_ns
             retry_after[ix] = res.retry_after_ns
 
-    def _forward_frame(self, kb, ix, mb, cp, pd, qt, now_ns, hops):
+    def _forward_frame(self, kb, ix, mb, cp, pd, qt, now_ns, hops,
+                       dl=None):
         sub = [kb[i] for i in ix]
         params = zip(mb[ix], cp[ix], pd[ix], qt[ix])
         if self.ring is not None:
+            if dl is not None and (dl[ix] > 0).any():
+                # Carry the remaining client budget (deadline - now) so
+                # the receiver sheds with ITS flush-time clock — a
+                # hop-chained request cannot outlive its client.  Rows
+                # without a deadline ride budget 0; batches with no
+                # deadline at all stay on the classic op (byte-
+                # identical kill switch).
+                budgets = np.where(dl[ix] > 0, dl[ix] - now_ns, 0)
+                return encode_droute(sub, params, now_ns, hops, budgets)
             return encode_route(sub, params, now_ns, hops)
         return encode_batch(sub, params, now_ns)
 
@@ -881,12 +976,16 @@ class ClusterLimiter(ScalarCompatMixin):
     def rate_limit_batch(
         self, keys, max_burst, count_per_period, period, quantity,
         now_ns: int, wire: bool = False, _part=None, _hops: int = 0,
+        deadlines_ns=None,
     ):
         """`_part` lets rate_limit_many pass the partition it already
         computed for its local-only probe, so no batch is partitioned
         twice.  `_hops` counts OP_ROUTE_BATCH forward hops (server
         path): at MAX_HOPS everything is decided here rather than
-        forwarded again."""
+        forwarded again.  `deadlines_ns` (i64 per key, 0 = none) sheds
+        rows already past their client deadline with STATUS_DEADLINE —
+        before any device dispatch or forward — and stamps the
+        remaining budget onto forwarded frames."""
         n = len(keys)
         force_local = self.ring is not None and _hops >= MAX_HOPS
         if force_local and _part is None:
@@ -903,12 +1002,32 @@ class ClusterLimiter(ScalarCompatMixin):
         cp = self._broadcast(count_per_period, n)
         pd = self._broadcast(period, n)
         qt = self._broadcast(quantity, n)
+        dl = None
+        expired = None
+        if deadlines_ns is not None:
+            dl = np.asarray(deadlines_ns, np.int64)
+            if dl.shape != (n,):
+                dl = np.broadcast_to(dl, (n,))
+            exp_mask = (dl > 0) & (dl <= now_ns)
+            if exp_mask.any():
+                # Shed expired rows from every partition: they must
+                # never reach a device or a peer.
+                expired = exp_mask
+                by_node = [ix[~expired[ix]] for ix in by_node]
 
         # A joining/rejoining node must not decide its ranges before the
         # predecessors' migrations land (zero lost decisions across the
         # handoff epoch).
         if self.ring is not None and len(by_node[self.self_index]):
             self._wait_handoff()
+
+        # A mid-leave lame duck parks forwards until its own OP_LEAVE /
+        # OP_MIGRATE stream is fully sent: forwards share each peer's
+        # connection with those frames, so per-connection ordering then
+        # guarantees the receiver has flipped its ring AND installed
+        # the handed-off state before any forwarded key arrives.
+        if self._lame_duck and not self._leave_complete.is_set():
+            self._leave_complete.wait(self.handoff_timeout_s)
 
         # Ship remote sub-batches first (pipelined), then decide locally
         # while peers work, then collect replies.  Ring mode holds each
@@ -939,7 +1058,7 @@ class ClusterLimiter(ScalarCompatMixin):
                 if d == self.self_index or len(ix) == 0:
                     continue
                 frame = self._forward_frame(
-                    kb, ix, mb, cp, pd, qt, now_ns, _hops + 1
+                    kb, ix, mb, cp, pd, qt, now_ns, _hops + 1, dl
                 )
                 peer = self.peers[d]
                 try:
@@ -1056,7 +1175,7 @@ class ClusterLimiter(ScalarCompatMixin):
         # (outside the pipelined round's request locks).
         for d, ix in moved_pairs:
             frame = self._forward_frame(
-                kb, ix, mb, cp, pd, qt, now_ns, _hops + 1
+                kb, ix, mb, cp, pd, qt, now_ns, _hops + 1, dl
             )
             rep = self._single_rpc(d, frame, len(ix))
             if rep is None:
@@ -1071,7 +1190,7 @@ class ClusterLimiter(ScalarCompatMixin):
             # replicated ranges.
             failed_nodes = self._failover_round(
                 failed_nodes, keys, kb, mb, cp, pd, qt, now_ns, wire,
-                arrays, _hops,
+                arrays, _hops, dl,
             )
 
         for _d, ix in failed_nodes:
@@ -1081,6 +1200,9 @@ class ClusterLimiter(ScalarCompatMixin):
             # Unencodable or over-length keys: each fails only itself.
             status[bad] = STATUS_INVALID_PARAMS
             allowed[bad] = False
+        if expired is not None:
+            status[expired] = STATUS_DEADLINE
+            allowed[expired] = False
 
         if self.capture and _hops == 0:
             # Per-batch capture at the cluster frontend (opt-in; see
@@ -1116,7 +1238,7 @@ class ClusterLimiter(ScalarCompatMixin):
 
     def _failover_round(
         self, failed_nodes, keys, kb, mb, cp, pd, qt, now_ns, wire,
-        arrays, hops,
+        arrays, hops, dl=None,
     ):
         """Re-route failed peers' keys to their ring successors (one
         round).  Keys whose successor is this node are decided locally
@@ -1150,7 +1272,7 @@ class ClusterLimiter(ScalarCompatMixin):
                     )
                     continue
                 frame = self._forward_frame(
-                    kb, eix, mb, cp, pd, qt, now_ns, hops + 1
+                    kb, eix, mb, cp, pd, qt, now_ns, hops + 1, dl
                 )
                 rep = self._single_rpc(e, frame, len(eix))
                 if rep is None:
@@ -1171,12 +1293,12 @@ class ClusterLimiter(ScalarCompatMixin):
         OP_MIGRATE is applied.  Entries are abandoned loudly after
         `handoff_timeout_s` or when the predecessor's breaker opens
         (state lost mid-handoff — availability wins, the GCRA clamp
-        bounds the damage)."""
-        import time
-
+        bounds the damage).  Deadlines are measured on `self._clock`
+        (injectable), so tests pin them against a virtual clock instead
+        of racing wall time under load."""
         with self._handoff_cv:
             while self._pending_from:
-                now = time.monotonic()
+                now = self._clock()
                 for d in list(self._pending_from):
                     peer = self.peers[d]
                     if now >= self._pending_from[d] or (
@@ -1342,6 +1464,14 @@ class ClusterLimiter(ScalarCompatMixin):
                     self.epoch += 1
                     epoch = self.epoch
                     self._absorbed.discard(origin)
+                    self._departed.discard(origin)
+                    if self.ring.weights.get(origin, 1.0) < 1e-9:
+                        # The origin left (planned OP_LEAVE) earlier:
+                        # a join re-registers it at full weight — and
+                        # the export below must run against the
+                        # restored ring, or it would hand nothing back
+                        # (a weight-0 node owns no points).
+                        self.ring = self.ring.with_weight(origin, 1.0)
                     ring = self.ring
                 if peer is not None:
                     # Any existing socket predates this announcement
@@ -1452,6 +1582,11 @@ class ClusterLimiter(ScalarCompatMixin):
             merged[self.self_index] = self.ring.weights.get(
                 self.self_index, 1.0
             )
+            # A departed peer stays at weight 0 until its own OP_JOIN:
+            # a broadcast from a node that has not yet seen the leave
+            # must not route keys at a gone node.
+            for d in self._departed:
+                merged[d] = 0.0
             if (
                 epoch == self.epoch
                 and [merged[i] for i in range(len(self.nodes))]
@@ -1469,6 +1604,188 @@ class ClusterLimiter(ScalarCompatMixin):
         from ..replay.recorder import maybe_record_event
 
         maybe_record_event("cluster-epoch", str(epoch))
+
+    def _export_all(self):
+        """EVERY exportable local-table row plus the replica store's
+        leftovers, for the leave handoff (caller holds device_lock).
+        Unlike _export_owned_by this is ring-blind: absorbed takeover
+        ranges and freshly-migrated rows all leave with us.  Replica
+        rows whose owner is alive are dropped, not exported — the owner
+        holds fresher state and re-replicates to its new successor on
+        the next decide; pushing our stale copy at anyone could clobber
+        a fresher TAT."""
+        from ..tpu.snapshot import export_state
+
+        kb: List[bytes] = []
+        tats: List[int] = []
+        exps: List[int] = []
+        try:
+            keys, _s, _sh, tat_col, exp_col, _c, _d = export_state(
+                self.local
+            )
+        except Exception:
+            log.exception("cluster export for leave failed")
+            keys, tat_col, exp_col = [], [], []
+        for i, k in enumerate(keys):
+            try:
+                kb.append(self._key_bytes(k))
+            except UnicodeEncodeError:
+                continue
+            tats.append(int(tat_col[i]))
+            exps.append(int(exp_col[i]))
+        with self._replica_mu:
+            self.replica_store.clear()
+        return kb, np.asarray(tats, np.int64), np.asarray(exps, np.int64)
+
+    def leave(self) -> bool:
+        """Planned departure: the join protocol in reverse.
+
+        Under device_lock (atomic with local decides, like on_join):
+        bump the epoch, enter lame-duck (ring weight 0 for self — every
+        key now forwards, nothing decides locally), export the WHOLE
+        local table grouped by the new ring's owners.  Then, outside
+        device_lock, per peer and on its one connection: OP_LEAVE
+        (the receiver flips its ring and gates its local decides on our
+        migrate, mirroring a joiner's handoff gate) followed by the
+        OP_MIGRATE rows (possibly the empty handoff-complete marker).
+        Per-connection ordering therefore lands the announcement before
+        the state and the state before any of our own forwards (which
+        park on _leave_complete until the stream is fully sent) — zero
+        lost decisions, zero replica staleness.
+
+        Returns True when every live peer acked the full stream; False
+        when the handoff was partial (a receiver's handoff deadline or
+        breaker unblocks it — the kill-path takeover bounds the
+        damage) or there was no live peer to hand off to."""
+        if self.ring is None or len(self.nodes) == 1:
+            return False
+        from ..replay.recorder import maybe_record_event
+        from .ring import batch_crc32
+
+        with self.device_lock:
+            with self._mu:
+                if self._lame_duck:
+                    return False
+                dead = self._dead_peers()
+                departed = set(self._departed)
+                live = [
+                    i
+                    for i in range(len(self.nodes))
+                    if i != self.self_index
+                    and i not in dead
+                    and i not in departed
+                ]
+                if not live:
+                    log.warning(
+                        "cluster leave aborted: no live peer to hand "
+                        "off to (kill path will cover the exit)"
+                    )
+                    return False
+                try:
+                    new_ring = self.ring.with_weight(self.self_index, 0.0)
+                except ValueError:
+                    return False
+                self.epoch += 1
+                epoch = self.epoch
+                self._lame_duck = True
+            log.warning(
+                "leaving cluster (epoch %d): handing off local key "
+                "range", epoch,
+            )
+            maybe_record_event("cluster-leave", str(self.self_index))
+            kb, tats, exps = self._export_all()
+            moved: dict = {}
+            if kb:
+                # Dead peers are excluded so an absorbed takeover range
+                # goes to its live successor, not back at the corpse.
+                owners = new_ring.owners_of(
+                    batch_crc32(kb), exclude=frozenset(dead)
+                )
+                for j, dest in enumerate(owners):
+                    dest = int(dest)
+                    if dest == self.self_index:
+                        continue
+                    rows = moved.setdefault(dest, ([], [], []))
+                    rows[0].append(kb[j])
+                    rows[1].append(int(tats[j]))
+                    rows[2].append(int(exps[j]))
+            with self._mu:
+                self.ring = new_ring
+        # Sends OUTSIDE device_lock (same rationale as on_join: a send
+        # blocked on socket buffers must not stall the decide path).
+        ok = True
+        try:
+            for dest, peer in enumerate(self.peers):
+                if peer is None or dest in departed:
+                    continue
+                ks, ts, es = moved.get(dest, ([], [], []))
+                try:
+                    maybe_fail("leave")
+                    with peer.lock:
+                        peer.send_frame(
+                            encode_leave(self.self_index, epoch)
+                        )
+                except (OSError, ConnectionError) as e:
+                    log.warning(
+                        "leave announce to %s failed: %s (its handoff "
+                        "deadline will unblock it)", self.nodes[dest], e,
+                    )
+                    _note_peer_error(peer, e)
+                    ok = False
+                    continue
+                if not self._send_migrate(
+                    dest, epoch, ks,
+                    np.asarray(ts, np.int64), np.asarray(es, np.int64),
+                ):
+                    ok = False
+        finally:
+            self.leave_count += 1
+            # Unpark lame-duck forwards even on a partial handoff —
+            # availability wins; receivers that missed frames time out
+            # of their gates and the takeover path bounds the damage.
+            self._leave_complete.set()
+        if ok:
+            log.info(
+                "cluster leave complete: %d keys handed off to %d "
+                "peers", sum(len(v[0]) for v in moved.values()),
+                len(moved),
+            )
+        return ok
+
+    def on_leave(self, origin: int, epoch: int) -> None:
+        """A peer announced planned departure: stop routing keys at it
+        and gate local decisions until its OP_MIGRATE lands (the frames
+        share one connection, so the migrate is right behind this
+        announcement — the gate only parks OTHER threads' decides for
+        that window).  Mirrors apply_ring's flip discipline: ring and
+        epoch move under _mu; in-flight batches re-validate their
+        partition epoch under device_lock before deciding."""
+        if (
+            self.ring is None
+            or origin == self.self_index
+            or not 0 <= origin < len(self.nodes)
+        ):
+            return
+        maybe_fail("leave")
+        from ..replay.recorder import maybe_record_event
+
+        maybe_record_event("cluster-leave", str(origin))
+        deadline = self._clock() + self.handoff_timeout_s
+        with self._handoff_cv:
+            self.epoch = max(self.epoch, epoch)
+            if self.ring.weights.get(origin, 1.0) > 1e-9:
+                self.ring = self.ring.with_weight(origin, 0.0)
+            self._departed.add(origin)
+            self.leave_count += 1
+            # Gate local decides until the leaver's state lands; a
+            # previous join's _handoff_done entry must not short-
+            # circuit this round's gate.
+            self._handoff_done.discard(origin)
+            self._pending_from[origin] = deadline
+        log.info(
+            "peer %s announced planned leave (epoch %d): gating on "
+            "its handoff", self.nodes[origin], epoch,
+        )
 
     def _ensure_takeover(self, dead: int) -> None:
         """First failover onto a dead peer's range: absorb its warm
@@ -1517,7 +1834,13 @@ class ClusterLimiter(ScalarCompatMixin):
             maybe_record_event("cluster-takeover", str(dead))
 
     def _replicating(self) -> bool:
-        return self.replicate and self._pump is not None
+        # A lame duck decides nothing new and is about to vanish —
+        # replicating its stream would only push staleness at peers.
+        return (
+            self.replicate
+            and self._pump is not None
+            and not self._lame_duck
+        )
 
     def _queue_replicas(
         self, kb, ix, mb, cp, pd, now_ns, res, wire: bool
@@ -1666,8 +1989,6 @@ class ClusterLimiter(ScalarCompatMixin):
     def announce_join_to(self, d: int, register_pending: bool = True):
         """OP_JOIN round trip to one peer: adopt its ring state and gate
         local decisions on its migrate.  Returns True on ack."""
-        import time
-
         peer = self.peers[d]
         if peer is None:
             return False
@@ -1713,7 +2034,7 @@ class ClusterLimiter(ScalarCompatMixin):
 
             self._reweight_heal_until = time.monotonic() + 30.0
         if register_pending:
-            deadline = time.monotonic() + self.handoff_timeout_s
+            deadline = self._clock() + self.handoff_timeout_s
             with self._handoff_cv:
                 if d not in self._handoff_done:
                     self._pending_from[d] = deadline
@@ -1744,7 +2065,7 @@ class ClusterLimiter(ScalarCompatMixin):
         ownership changes on our side (the re-partition epoch check
         re-validates in-flight batches, same result), and receivers
         converge as soon as one frame lands."""
-        if self.ring is None:
+        if self.ring is None or self._lame_duck:
             return
         with self._mu:
             self.epoch += 1
@@ -1776,7 +2097,7 @@ class ClusterLimiter(ScalarCompatMixin):
         peer's OP_MIGRATE is sent before the ring flip (and before
         OP_RING on the same connection), so by the time anyone routes a
         moved key to its new owner, the state is already there."""
-        if self.ring is None or len(self.nodes) == 1:
+        if self.ring is None or len(self.nodes) == 1 or self._lame_duck:
             return
         from .ring import batch_crc32
 
@@ -1850,7 +2171,14 @@ class ClusterLimiter(ScalarCompatMixin):
 
     # ------------------------------------------------------------------ #
 
-    def rate_limit_many(self, batches, wire: bool = False) -> list:
+    #: Feature marker for the engine: dispatch_many/rate_limit_many
+    #: accept a per-batch `deadlines` argument (forward-budget
+    #: propagation); plain limiters never see the kwarg.
+    accepts_deadlines = True
+
+    def rate_limit_many(
+        self, batches, wire: bool = False, deadlines=None
+    ) -> list:
         """K batches in arrival order.
 
         Windows whose keys are ALL locally owned take the local scan path
@@ -1861,7 +2189,9 @@ class ClusterLimiter(ScalarCompatMixin):
         frame pipelining).  Per-key arrival order holds either way
         because a key always routes to the same node.
         """
-        return self.dispatch_many(batches, wire=wire).fetch()
+        return self.dispatch_many(
+            batches, wire=wire, deadlines=deadlines
+        ).fetch()
 
     def dispatch_wire_window(self, frames, now_ns: int):
         """Cluster front for the fully-native wire path: windows whose
@@ -1915,16 +2245,22 @@ class ClusterLimiter(ScalarCompatMixin):
         with self.device_lock:
             return inner(frames, now_ns)
 
-    def dispatch_many(self, batches, wire: bool = False):
+    def dispatch_many(self, batches, wire: bool = False, deadlines=None):
         """Dispatch/fetch split for the engine's double-buffered flush
         loop.  Windows whose keys are ALL locally owned dispatch through
         the local limiter's own split (the device lock covers only the
         dispatch; launches are sequenced by the donated table state, so
         the fetch can run lock-free later).  Windows with remote keys
         decide synchronously inside this call — peer RPC and device work
-        interleave per batch — and return ready results."""
+        interleave per batch — and return ready results.  `deadlines`
+        (one i64 array per batch, or None) rides the per-batch path so
+        forwarded rows carry their remaining client budget; the engine
+        already shed rows expired at flush time, so the local fast path
+        has nothing to do with them."""
         if not batches:
             return _ReadyLaunch([])
+        if deadlines is None:
+            deadlines = [None] * len(batches)
         can_async = hasattr(self.local, "dispatch_many")
         can_scan = hasattr(self.local, "rate_limit_many")
         # Partition each batch exactly once: the local-only probe hands its
@@ -1962,15 +2298,22 @@ class ClusterLimiter(ScalarCompatMixin):
                         )
             if stale:
                 return _ReadyLaunch(
-                    [self.rate_limit_batch(*b, wire=wire) for b in batches]
+                    [
+                        self.rate_limit_batch(
+                            *b, wire=wire, deadlines_ns=dl
+                        )
+                        for b, dl in zip(batches, deadlines)
+                    ]
                 )
             if self._replicating():
                 return _ReplicatingLaunch(self, handle, batches, parts, wire)
             return handle
         return _ReadyLaunch(
             [
-                self.rate_limit_batch(*b, wire=wire, _part=part)
-                for b, part in zip(batches, parts)
+                self.rate_limit_batch(
+                    *b, wire=wire, _part=part, deadlines_ns=dl
+                )
+                for b, part, dl in zip(batches, parts, deadlines)
             ]
         )
 
@@ -2147,6 +2490,7 @@ class _ClusterPump(threading.Thread):
                 now = time.monotonic()
                 if (
                     cl.ring is not None
+                    and not cl._lame_duck
                     and now >= self._rebroadcast_at
                     and (
                         abs(
@@ -2160,9 +2504,19 @@ class _ClusterPump(threading.Thread):
                     cl.rebroadcast_ring()
                 # Partition-heal probe: periodically re-announce to
                 # peers whose breaker is open; a successful round trip
-                # heals the link and migrates their range back.
+                # heals the link and migrates their range back.  A
+                # lame duck stops probing (it is on its way out), and
+                # a DEPARTED peer's closed socket must not be read as
+                # a partition to heal — it left on purpose; only its
+                # own OP_JOIN re-registers it.
+                if cl._lame_duck:
+                    continue
+                with cl._mu:
+                    departed = set(cl._departed)
                 for d, peer in enumerate(cl.peers):
                     if peer is None or not peer.breaker_open:
+                        continue
+                    if d in departed:
                         continue
                     if now < self._reannounce_at.get(d, 0.0):
                         continue
@@ -2263,11 +2617,14 @@ class ClusterServer:
     def bound_port(self) -> int:
         return self._server.sockets[0].getsockname()[1]
 
-    def _decide_frame(self, keys, params, now_ns, hops: Optional[int]):
+    def _decide_frame(self, keys, params, now_ns, hops: Optional[int],
+                      deadlines=None):
         """Decide a forwarded batch (executor thread) and encode the
         reply.  `hops=None` is the legacy decide-all contract; an int
         routes through the cluster's ownership check, which may forward
-        non-owned keys onward (membership skew) up to MAX_HOPS."""
+        non-owned keys onward (membership skew) up to MAX_HOPS.
+        `deadlines` (absolute ns in THIS node's clock, 0 = none) sheds
+        rows whose client budget ran out in flight."""
         try:
             if hops is None or self.cluster is None:
                 with self.limiter_lock:
@@ -2281,6 +2638,7 @@ class ClusterServer:
                 res = self.cluster.rate_limit_batch(
                     keys, params[:, 0], params[:, 1], params[:, 2],
                     params[:, 3], now_ns, _hops=hops,
+                    deadlines_ns=deadlines,
                 )
             return encode_reply(
                 res.status, res.allowed, res.limit, res.remaining,
@@ -2308,7 +2666,8 @@ class ClusterServer:
                 if ring_ops:
                     batch_ops = (
                         OP_THROTTLE_BATCH, OP_ROUTE_BATCH, OP_MIGRATE,
-                        OP_REPLICA, OP_RING, OP_JOIN,
+                        OP_REPLICA, OP_RING, OP_JOIN, OP_LEAVE,
+                        OP_DROUTE_BATCH,
                     )
                 if body_len > MAX_FRAME or op not in batch_ops:
                     log.warning("bad cluster frame (op=%d len=%d)", op,
@@ -2366,8 +2725,22 @@ class ClusterServer:
                         self._lifecycle_pool, cl.on_join, origin
                     )
                     continue
+                if op == OP_LEAVE:
+                    origin, epoch = decode_leave(body)
+                    # Pure host work under _mu (a ring rebuild), like
+                    # apply_ring — the dedicated ring executor keeps
+                    # it off the loop and unstarvable.
+                    await loop.run_in_executor(
+                        self._ring_pool, cl.on_leave, origin, epoch,
+                    )
+                    continue  # fire-and-forget: no reply frame
                 hops: Optional[int] = None
-                if op == OP_ROUTE_BATCH:
+                budgets = None
+                if op == OP_DROUTE_BATCH:
+                    hops, keys, params, now_ns, budgets = decode_droute(
+                        body
+                    )
+                elif op == OP_ROUTE_BATCH:
                     hops, keys, params, now_ns = decode_route(body)
                 else:
                     keys, params, now_ns = decode_batch(body)
@@ -2379,8 +2752,17 @@ class ClusterServer:
                     ]
                 if self.now_fn is not None:
                     now_ns = self.now_fn()
+                deadlines = None
+                if budgets is not None:
+                    # Rebase the carried budget onto THIS node's clock
+                    # (now_ns was just refreshed) — no cross-node clock
+                    # comparison ever happens.  Each hop deducts its
+                    # own dwell time before re-forwarding, so the
+                    # budget shrinks monotonically across hops.
+                    deadlines = np.where(budgets > 0, now_ns + budgets, 0)
                 frame = await loop.run_in_executor(
-                    None, self._decide_frame, keys, params, now_ns, hops
+                    None, self._decide_frame, keys, params, now_ns,
+                    hops, deadlines,
                 )
                 writer.write(frame)
                 await writer.drain()

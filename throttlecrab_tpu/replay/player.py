@@ -41,8 +41,10 @@ from .trace import SOURCE_CLUSTER_BASE, Trace
 
 #: Recorded statuses excluded from comparison by default: 3 = internal
 #: (a fault fired mid-run; deterministic fault replay pins those runs
-#: instead), 4 = overloaded (admission shed is queue-depth-dependent).
-DEFAULT_IGNORE_STATUSES = (3, 4)
+#: instead), 4 = overloaded (admission shed is queue-depth-dependent),
+#: 6 = deadline exceeded (queue-dwell-dependent: a replay's dwell times
+#: differ, so which rows expired in queue is an environment fact).
+DEFAULT_IGNORE_STATUSES = (3, 4, 6)
 
 
 def _next_pow2(n: int) -> int:
@@ -304,6 +306,16 @@ class ClusterReplayer:
             self.ensure_joined(int(event.detail))
         elif event.kind == "cluster-takeover":
             self.kill(int(event.detail))
+        elif event.kind == "cluster-leave":
+            self.leave(int(event.detail))
+
+    def leave(self, index: int) -> None:
+        """Replay a planned departure: the node hands off its range
+        (the state-preserving path the live run took), then dies."""
+        node = self.nodes[index]
+        if node is not None:
+            node.leave()
+            self.nodes[index] = None
 
     def replay(self, trace: Trace, settle_s: float = 0.5):
         """Process records in capture order: lifecycle events mutate
@@ -318,7 +330,7 @@ class ClusterReplayer:
         wi = 0
         for kind, rec in trace.records:
             if kind == REC_EVENT:
-                if rec.kind == "cluster-takeover":
+                if rec.kind in ("cluster-takeover", "cluster-leave"):
                     # Before killing a node, give the replica pump the
                     # flush window the live run's pre-kill traffic had —
                     # the warm-standby copy must land on the successor
@@ -401,3 +413,9 @@ class _ReplayNode:
         self.cl.close()
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(timeout=5)
+
+    def leave(self):
+        """Planned departure: hand the key range off (zero-staleness
+        path), then tear down like kill()."""
+        self.cl.leave()
+        self.kill()

@@ -295,6 +295,7 @@ async def run_server(config: Config) -> None:
         front=front,
         insight=insight,
         control=control,
+        deadline_default_ms=config.deadline_default_ms,
     )
     transports = build_transports(config, engine, metrics)
     if cluster_nodes:
@@ -326,14 +327,27 @@ async def run_server(config: Config) -> None:
         limiter.start_membership()
 
     stop = asyncio.Event()
+    drain_requested = False
 
-    def _signal_handler() -> None:
-        log.info("shutdown signal received")
+    def _signal_handler(graceful: bool) -> None:
+        nonlocal drain_requested
+        log.info(
+            "shutdown signal received (%s)",
+            "drain" if graceful else "kill",
+        )
+        if graceful:
+            drain_requested = True
         stop.set()
 
-    for sig in (signal.SIGINT, signal.SIGTERM):
+    # SIGTERM (the orchestrator's planned-stop signal) drains: stop
+    # accepting, flush queued requests with real decisions, planned
+    # cluster leave, snapshot.  SIGINT keeps today's abrupt kill path.
+    for sig, graceful in (
+        (signal.SIGINT, False),
+        (signal.SIGTERM, True),
+    ):
         try:
-            loop.add_signal_handler(sig, _signal_handler)
+            loop.add_signal_handler(sig, _signal_handler, graceful)
         except NotImplementedError:  # pragma: no cover - non-unix
             pass
 
@@ -355,6 +369,44 @@ async def run_server(config: Config) -> None:
 
     log.info("shutting down")
     stop_task.cancel()
+    if drain_requested and config.drain_timeout_ms > 0 and not failed:
+        # Graceful drain, bounded: past the budget the node degrades to
+        # the abrupt kill path below (cluster peers' replica takeover
+        # bounds the damage exactly as for a crash).
+        async def _drain() -> None:
+            # 1. De-route: health answers "draining", listeners stop
+            #    accepting new connections (established ones keep
+            #    serving until stop() below).
+            engine.begin_drain()
+            for transport in transports:
+                drain_hook = getattr(transport, "drain", None)
+                if drain_hook is not None:
+                    await drain_hook()
+            # 2. Flush everything already queued with real decisions.
+            await engine.drain()
+            # 3. Planned cluster leave: stream our key range to the new
+            #    owners (zero lost decisions, zero replica staleness) —
+            #    blocking socket work, so on the executor.
+            if cluster_nodes and config.cluster_vnodes > 0:
+                left = await loop.run_in_executor(None, limiter.leave)
+                if not left:
+                    log.warning(
+                        "planned leave unavailable; peers take over "
+                        "via the kill path"
+                    )
+
+        try:
+            await asyncio.wait_for(
+                _drain(), config.drain_timeout_ms / 1000.0
+            )
+            log.info("drain complete")
+        except asyncio.TimeoutError:
+            log.warning(
+                "drain timed out after %dms; falling back to the "
+                "kill path", config.drain_timeout_ms,
+            )
+        except Exception:
+            log.exception("drain failed; falling back to the kill path")
     await engine.shutdown()
     if recorder is not None:
         # Finalize the trace: full mode flushes + closes its incremental

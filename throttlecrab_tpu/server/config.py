@@ -200,6 +200,21 @@ _SPEC = [
      100_000, int,
      "Bound on warm-standby replica rows held for ring predecessors "
      "(overflow evicts the coldest row)"),
+    # --- graceful lifecycle (leave/drain/deadline, PR 17) ---------------
+    ("drain_timeout_ms", "THROTTLECRAB_DRAIN_TIMEOUT_MS", 10_000, int,
+     "SIGTERM drain budget in milliseconds: stop accepting, flush "
+     "in-flight batches with real decisions, run the planned cluster "
+     "leave (zero-staleness handoff) and snapshot; past the budget "
+     "the node falls back to the abrupt kill path (replica takeover "
+     "bounds the damage).  0 skips the drain entirely — SIGTERM "
+     "behaves like SIGINT"),
+    ("deadline_default_ms", "THROTTLECRAB_DEADLINE_DEFAULT_MS", 0, int,
+     "Default per-request deadline stamped on requests that carry "
+     "none (milliseconds; 0 — the default — stamps nothing and is "
+     "byte-identical to the deadline feature absent).  Requests still "
+     "queued past their deadline are shed before device dispatch with "
+     "the timeout status (HTTP 504 / gRPC DEADLINE_EXCEEDED / RESP "
+     "-ERR)"),
     # --- insight tier (L3.75: device-resident traffic analytics) --------
     ("insight", "THROTTLECRAB_INSIGHT", True, bool,
      "Insight tier: device-resident traffic analytics riding every "
@@ -316,6 +331,8 @@ class Config:
     cluster_replicate: bool = True
     cluster_handoff_timeout_ms: int = 5000
     cluster_replica_cap: int = 100_000
+    drain_timeout_ms: int = 10_000
+    deadline_default_ms: int = 0
     insight: bool = True
     insight_topk: int = 64
     insight_sketch: int = 4096
@@ -478,6 +495,10 @@ class Config:
             raise ConfigError("cluster_handoff_timeout_ms must be > 0")
         if self.cluster_replica_cap < 0:
             raise ConfigError("cluster_replica_cap must be >= 0")
+        if self.drain_timeout_ms < 0:
+            raise ConfigError("drain_timeout_ms must be >= 0")
+        if self.deadline_default_ms < 0:
+            raise ConfigError("deadline_default_ms must be >= 0")
         nodes = self.cluster_node_list()
         if nodes:
             if not 0 <= self.cluster_index < len(nodes):

@@ -38,6 +38,7 @@ from typing import Optional
 from ..front import OverloadError  # re-exported for the transports
 from ..tpu.cleanup import CleanupPolicy
 from ..tpu.limiter import (
+    STATUS_DEADLINE,
     STATUS_INTERNAL,
     STATUS_INVALID_PARAMS,
     STATUS_NEGATIVE_QUANTITY,
@@ -46,13 +47,16 @@ from ..tpu.limiter import (
 )
 from .types import ThrottleRequest, ThrottleResponse
 
-__all__ = ["BatchingEngine", "OverloadError", "ThrottleError"]
+__all__ = [
+    "BatchingEngine", "DeadlineError", "OverloadError", "ThrottleError",
+]
 
 STATUS_MESSAGES = {
     STATUS_NEGATIVE_QUANTITY: "quantity cannot be negative",
     STATUS_INVALID_PARAMS: "invalid rate limit parameters",
     STATUS_INTERNAL: "internal error",
     STATUS_TENANT_QUOTA: "tenant capacity quota exceeded",
+    STATUS_DEADLINE: "deadline exceeded",
 }
 
 
@@ -60,6 +64,12 @@ class ThrottleError(Exception):
     """Per-request validation failure, mapped by each transport to its
     protocol's error shape (the reference returns 500 JSON / gRPC
     Status::internal / RESP -ERR)."""
+
+
+class DeadlineError(ThrottleError):
+    """The request outlived its client deadline while queued: shed
+    before device dispatch.  Each transport maps it to its protocol's
+    timeout shape (HTTP 504 / gRPC DEADLINE_EXCEEDED / RESP -ERR)."""
 
 
 class BatchingEngine:
@@ -79,6 +89,7 @@ class BatchingEngine:
         front=None,
         insight=None,
         control=None,
+        deadline_default_ms: int = 0,
     ) -> None:
         """`limiter` is a TpuRateLimiter / ShardedTpuRateLimiter (or any
         object with rate_limit_batch + sweep).  `now_fn` injects time for
@@ -95,7 +106,9 @@ class BatchingEngine:
         on GET /stats.  `control` is an optional control.ControlPlane
         (L3.9): the engine drives its throttled tick between flushes
         under the same discipline (None — the default — means no
-        sensor read and no knob ever moves)."""
+        sensor read and no knob ever moves).  `deadline_default_ms` > 0
+        stamps that default deadline on requests that did not carry
+        one (0 — the default — stamps nothing)."""
         import threading
         import time
 
@@ -150,6 +163,19 @@ class BatchingEngine:
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._flush_lock = asyncio.Lock()
         self._closed = False
+        #: Draining (graceful shutdown): new requests shed with
+        #: OverloadError while queued ones still resolve with real
+        #: decisions; /health reports "draining" so balancers de-route.
+        self._draining = False
+        self.deadline_default_ms = int(deadline_default_ms)
+        #: ClusterLimiter advertises this: forwards can carry each
+        #: row's remaining deadline budget to the owning node.
+        self._limiter_takes_deadlines = bool(
+            getattr(limiter, "accepts_deadlines", False)
+        )
+        # Shed diagnostics (exported by metrics as *_total counters).
+        self.drain_shed = 0
+        self.deadline_shed = 0
         # Strong refs: the event loop only weakly references tasks, and a
         # GC'd flush task would strand its batch's futures forever.
         self._flush_tasks: set = set()
@@ -173,6 +199,18 @@ class BatchingEngine:
         relief valve, not the load."""
         if self._closed:
             raise ThrottleError("engine is shut down")
+        if self._draining:
+            # Graceful drain: the listener may race a few last arrivals
+            # in; they are shed as overload (503) while already-queued
+            # requests still get real decisions.
+            self.drain_shed += 1
+            if self.metrics is not None:
+                self.metrics.record_drain_shed()
+            raise OverloadError("server draining")
+        if request.deadline_ns is None and self.deadline_default_ms > 0:
+            request.deadline_ns = (
+                self.now_fn() + self.deadline_default_ms * 1_000_000
+            )
         front = self.front
         if front is not None:
             hit = front.lookup(
@@ -250,8 +288,9 @@ class BatchingEngine:
                 if windows:
                     now_ns = self.now_fn()
                     self._profile_tick()
+                    dl_kw = self._deadline_many_kw(windows)
 
-                    def do_dispatch(ws=windows, t=now_ns):
+                    def do_dispatch(ws=windows, t=now_ns, dk=dl_kw):
                         from ..tpu.profiling import annotate
 
                         with self.limiter_lock, annotate("gcra_dispatch"):
@@ -278,6 +317,7 @@ class BatchingEngine:
                                     for w in ws
                                 ],
                                 **self._wire_many_kw,
+                                **dk,
                             )
 
                     try:
@@ -295,7 +335,10 @@ class BatchingEngine:
 
     def _take_windows(self, can_scan: bool) -> list:
         """Pop up to max_scan_depth × batch_size pending requests, chunked
-        into batch-sized windows (arrival order preserved)."""
+        into batch-sized windows (arrival order preserved).  Requests
+        whose client deadline already lapsed are shed HERE — before any
+        device dispatch — with DeadlineError (HTTP 504 / gRPC
+        DEADLINE_EXCEEDED / RESP -ERR per transport)."""
         if not self._pending:
             return []
         n_batches = (
@@ -308,10 +351,59 @@ class BatchingEngine:
         )
         take = min(n_batches * self.batch_size, len(self._pending))
         flat = [self._pending.popleft() for _ in range(take)]
+        if any(r.deadline_ns is not None for r, _ in flat):
+            now_ns = self.now_fn()
+            live = []
+            shed = []
+            for r, fut in flat:
+                if r.deadline_ns is not None and r.deadline_ns <= now_ns:
+                    shed.append((r, fut))
+                else:
+                    live.append((r, fut))
+            if shed:
+                self.deadline_shed += len(shed)
+                if self.metrics is not None:
+                    self.metrics.record_deadline_shed(len(shed))
+                front = self.front
+                if front is not None and front.deny_cache is not None:
+                    # The rows never reach a launch: release their
+                    # in-flight holds (the shed-path twin the native
+                    # driver's _front_filter uses), nothing to fail.
+                    norm = [
+                        k
+                        for r, _ in shed
+                        if (k := front._norm_key(r.key)) is not None
+                    ]
+                    front.release_window(norm)
+                for r, fut in shed:
+                    if not fut.done():
+                        fut.set_exception(
+                            DeadlineError(STATUS_MESSAGES[STATUS_DEADLINE])
+                        )
+            flat = live
         return [
             flat[i : i + self.batch_size]
             for i in range(0, take, self.batch_size)
+            if flat[i : i + self.batch_size]
         ]
+
+    def _deadline_many_kw(self, windows) -> dict:
+        """Per-window remaining-deadline columns for a deadline-aware
+        limiter (ClusterLimiter: forwards carry the budget so a
+        hop-chained request can't outlive its client).  Empty dict —
+        byte-identical legacy call — when the limiter doesn't take them
+        or no request in the flush carries one."""
+        if not self._limiter_takes_deadlines:
+            return {}
+        if not any(
+            r.deadline_ns is not None for w in windows for r, _ in w
+        ):
+            return {}
+        return {
+            "deadlines": [
+                [r.deadline_ns or 0 for r, _ in w] for w in windows
+            ]
+        }
 
     def _fail_windows(self, windows, exc) -> None:
         front = self.front
@@ -448,6 +540,7 @@ class BatchingEngine:
         now_ns = self.now_fn()
         loop = asyncio.get_running_loop()
         self._profile_tick()
+        dl_kw = self._deadline_many_kw(windows)
 
         def launch():
             from ..tpu.profiling import annotate
@@ -467,6 +560,7 @@ class BatchingEngine:
                         for window in windows
                     ],
                     **self._wire_many_kw,
+                    **dl_kw,
                 )
 
         t0 = time.monotonic()
@@ -499,6 +593,13 @@ class BatchingEngine:
         now_ns = self.now_fn()
         loop = asyncio.get_running_loop()
         self._profile_tick()
+        dl_kw = {}
+        if self._limiter_takes_deadlines and any(
+            r.deadline_ns is not None for r in requests
+        ):
+            dl_kw = {
+                "deadlines_ns": [r.deadline_ns or 0 for r in requests]
+            }
 
         def launch():
             from ..tpu.profiling import annotate
@@ -513,6 +614,7 @@ class BatchingEngine:
                     [r.quantity for r in requests],
                     now_ns,
                     **self._wire_kw,
+                    **dl_kw,
                 )
 
         t0 = time.monotonic()
@@ -547,6 +649,12 @@ class BatchingEngine:
                 # "tenant over quota, back off" from a 500-class fault.
                 fut.set_exception(
                     OverloadError(STATUS_MESSAGES[STATUS_TENANT_QUOTA])
+                )
+            elif status == STATUS_DEADLINE:
+                # Shed at a cluster hop (the owner saw the budget lapse):
+                # same protocol shape as the engine's own flush-time shed.
+                fut.set_exception(
+                    DeadlineError(STATUS_MESSAGES[STATUS_DEADLINE])
                 )
             elif status != STATUS_OK:
                 fut.set_exception(
@@ -698,9 +806,28 @@ class BatchingEngine:
         and "shutdown" once the engine refuses new requests)."""
         if self._closed:
             return "shutdown"
+        if self._draining:
+            return "draining"
         from .supervisor import supervisor_state
 
         return supervisor_state(self.limiter)
+
+    def begin_drain(self) -> None:
+        """Flip to lame-duck serving: new requests shed with
+        OverloadError, /health says "draining" (balancers de-route),
+        queued requests keep resolving with real decisions."""
+        self._draining = True
+
+    async def drain(self) -> None:
+        """Graceful half of shutdown: stop taking requests, then flush
+        everything already queued with *real* decisions (shutdown()'s
+        pinned abrupt behavior also flushes, but nothing stops arrivals
+        racing in behind it — drain closes the front door first)."""
+        self.begin_drain()
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        await self._flush()
 
     async def shutdown(self) -> None:
         """Flush outstanding requests and refuse new ones."""

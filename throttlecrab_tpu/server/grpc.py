@@ -20,7 +20,12 @@ from typing import Optional
 import grpc
 import grpc.aio
 
-from .engine import BatchingEngine, OverloadError, ThrottleError
+from .engine import (
+    BatchingEngine,
+    DeadlineError,
+    OverloadError,
+    ThrottleError,
+)
 from .metrics import Metrics
 from .proto import throttlecrab_pb2 as pb
 from .types import ThrottleRequest
@@ -95,6 +100,15 @@ class GrpcTransport:
             # a free probe, matching the library's quantity-0 semantics.
             quantity=request.quantity,
         )
+        # gRPC carries deadlines natively: map the call's remaining
+        # budget onto the engine queue entry so an expired-in-queue
+        # request is shed host-side (DEADLINE_EXCEEDED) instead of
+        # spending a device launch the client will never see.
+        remaining_s = context.time_remaining()
+        if remaining_s is not None:
+            internal.deadline_ns = self.engine.now_fn() + int(
+                remaining_s * 1e9
+            )
         try:
             response = await self.engine.throttle(internal)
         except OverloadError as e:
@@ -102,6 +116,9 @@ class GrpcTransport:
             # overload status (clients back off; INTERNAL means a bug).
             self.metrics.record_error(self.name)
             await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except DeadlineError as e:
+            self.metrics.record_error(self.name)
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except ThrottleError as e:
             self.metrics.record_error(self.name)
             await context.abort(grpc.StatusCode.INTERNAL, str(e))

@@ -21,7 +21,12 @@ import json
 import logging
 from typing import Optional
 
-from .engine import BatchingEngine, OverloadError, ThrottleError
+from .engine import (
+    BatchingEngine,
+    DeadlineError,
+    OverloadError,
+    ThrottleError,
+)
 from .metrics import Metrics
 from .transport_base import ConnTrackingMixin
 from .types import ThrottleRequest
@@ -82,7 +87,7 @@ class HttpTransport(ConnTrackingMixin):
                     != "close"
                 )
                 status, payload, content_type = await self._route(
-                    method, path, body
+                    method, path, body, headers
                 )
                 await self._write_response(
                     writer, status, payload, content_type, keep_alive
@@ -135,9 +140,11 @@ class HttpTransport(ConnTrackingMixin):
         body = await reader.readexactly(length) if length else b""
         return method, path, headers, body
 
-    async def _route(self, method: str, path: str, body: bytes):
+    async def _route(
+        self, method: str, path: str, body: bytes, headers=None
+    ):
         if method == "POST" and path == "/throttle":
-            return await self._handle_throttle(body)
+            return await self._handle_throttle(body, headers or {})
         if method == "GET" and path == "/health":
             # "OK" in the ok state (reference-compatible, http.rs:141);
             # otherwise the failure-domain state machine's state name
@@ -219,8 +226,13 @@ class HttpTransport(ConnTrackingMixin):
             return 200, payload.encode(), "application/json"
         return 404, b"Not Found", "text/plain"
 
-    async def _handle_throttle(self, body: bytes):
-        """http.rs:123-159 — server timestamp, quantity default 1."""
+    async def _handle_throttle(self, body: bytes, headers=None):
+        """http.rs:123-159 — server timestamp, quantity default 1.
+
+        `X-Throttlecrab-Deadline-Ms: N` (optional) stamps a client
+        deadline N ms out; a request still queued past it is shed with
+        504 instead of spending a device launch on an answer the client
+        stopped waiting for."""
         try:
             data = json.loads(body)
             request = ThrottleRequest(
@@ -230,6 +242,17 @@ class HttpTransport(ConnTrackingMixin):
                 period=int(data["period"]),
                 quantity=int(data.get("quantity", 1)),
             )
+            deadline_ms = (
+                headers.get("x-throttlecrab-deadline-ms")
+                if headers
+                else None
+            )
+            if deadline_ms is not None:
+                ms = int(deadline_ms)
+                if ms > 0:
+                    request.deadline_ns = (
+                        self.engine.now_fn() + ms * 1_000_000
+                    )
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             self.metrics.record_error(self.name)
             return (
@@ -246,6 +269,16 @@ class HttpTransport(ConnTrackingMixin):
             self.metrics.record_error(self.name)
             return (
                 503,
+                json.dumps({"error": str(e)}).encode(),
+                "application/json",
+            )
+        except DeadlineError as e:
+            # The client's deadline lapsed in-queue: 504, the HTTP
+            # timeout status (clients gave up; 500 would page for a
+            # condition the client caused).
+            self.metrics.record_error(self.name)
+            return (
+                504,
                 json.dumps({"error": str(e)}).encode(),
                 "application/json",
             )
@@ -275,7 +308,8 @@ class HttpTransport(ConnTrackingMixin):
     ) -> None:
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   500: "Internal Server Error",
-                  503: "Service Unavailable"}.get(status, "OK")
+                  503: "Service Unavailable",
+                  504: "Gateway Timeout"}.get(status, "OK")
         head = (
             f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: {content_type}\r\n"

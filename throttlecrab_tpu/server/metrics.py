@@ -63,7 +63,11 @@ METRIC_NAMES = (
     "throttlecrab_cluster_migrated_in_total",
     "throttlecrab_cluster_replica_rows",
     "throttlecrab_cluster_takeovers_total",
+    "throttlecrab_cluster_leaves_total",
     "throttlecrab_cluster_epoch",
+    # Graceful lifecycle (drain + deadline shed, server/engine.py).
+    "throttlecrab_tpu_drain_shed_total",
+    "throttlecrab_tpu_deadline_shed_total",
     # Insight tier (L3.75, insight/).
     "throttlecrab_tpu_insight_allowed_rate",
     "throttlecrab_tpu_insight_denied_rate",
@@ -162,6 +166,9 @@ class Metrics:
         self.supervisor_retries = 0
         self.supervisor_degrades = 0
         self.supervisor_repromotes = 0
+        # Graceful lifecycle (drain + deadline shed).
+        self.drain_shed = 0
+        self.deadline_shed = 0
         self._engine_state = None
         # Insight tier (L3.75).
         self._insight_stats = None
@@ -284,6 +291,19 @@ class Metrics:
         """Device recovery: host-mutated state re-promoted on-device."""
         with self._lock:
             self.supervisor_repromotes += 1
+
+    # ---- graceful lifecycle ------------------------------------------ #
+
+    def record_drain_shed(self, n: int = 1) -> None:
+        """Arrivals refused while the server drains (503)."""
+        with self._lock:
+            self.drain_shed += n
+
+    def record_deadline_shed(self, n: int = 1) -> None:
+        """Requests shed before device dispatch because their client
+        deadline lapsed in-queue (504 / DEADLINE_EXCEEDED)."""
+        with self._lock:
+            self.deadline_shed += n
 
     def set_engine_state_provider(self, provider) -> None:
         """`provider()` -> "ok"|"retrying"|"degraded"|"recovering";
@@ -490,6 +510,21 @@ class Metrics:
             "counter",
             self.supervisor_repromotes,
         )
+        # Graceful lifecycle (server/engine.py drain + deadline shed).
+        metric(
+            "throttlecrab_tpu_drain_shed_total",
+            "Arrivals refused while draining (balancers should have "
+            "de-routed; the stragglers get 503)",
+            "counter",
+            self.drain_shed,
+        )
+        metric(
+            "throttlecrab_tpu_deadline_shed_total",
+            "Requests shed host-side because their client deadline "
+            "lapsed before device dispatch",
+            "counter",
+            self.deadline_shed,
+        )
         # Fault injection (chaos runs): per-site fired counts from the
         # armed injector, so a soak can assert the fault actually fired.
         from ..faults import active_injector
@@ -658,6 +693,13 @@ class Metrics:
                 "Dead-peer ranges absorbed from the warm replica",
                 "counter",
                 view.get("takeovers", 0),
+            )
+            metric(
+                "throttlecrab_cluster_leaves_total",
+                "Planned departures observed (own leave + peers' "
+                "OP_LEAVE announcements applied)",
+                "counter",
+                view.get("leaves", 0),
             )
         return "\n".join(out) + "\n"
 

@@ -30,6 +30,7 @@ import numpy as np
 from ..front import STATUS_OVERLOADED
 from ..native import get_wire_lib
 from ..tpu.limiter import (
+    STATUS_DEADLINE,
     STATUS_INTERNAL,
     WireBatchResult,
     limiter_uses_bytes_keys,
@@ -118,9 +119,15 @@ class NativeRedisTransport:
         B = batch_size
         self._key_buf = ctypes.create_string_buffer(B * 256 + (128 << 10))
         self._offsets = np.zeros(B + 1, np.int64)
-        self._params = np.zeros(4 * B, np.int64)
+        # Stride 5: the wire layer appends a remaining-deadline-budget
+        # column (ns; 0 = none, negative = expired at pop).
+        self._params = np.zeros(5 * B, np.int64)
         self._cookie_gen = np.zeros(B, np.uint64)
         self._cookie_fd = np.zeros(B, np.int32)
+        # Graceful drain: once set, /health (HTTP protocol) reports
+        # "draining" so balancers stop routing here while the driver
+        # keeps answering already-queued requests.
+        self._draining = False
 
     # ------------------------------------------------------------------ #
 
@@ -151,6 +158,17 @@ class NativeRedisTransport:
             await asyncio.sleep(0.5)
             if self._driver is not None and not self._driver.is_alive():
                 raise RuntimeError("native redis driver thread died")
+
+    async def drain(self) -> None:
+        """Graceful-drain hook: advertise "draining" on /health (HTTP
+        protocol) so balancers stop routing here.  The listener stays
+        up and the driver keeps answering queued requests — the C++
+        wire layer has no accept gate, so the health flip is the
+        routing signal; stop() drops connections afterwards."""
+        self._draining = True
+        if self.PROTOCOL == 1:
+            body = b"draining"
+            self._lib.ws_set_health(self._h, body, len(body))
 
     async def stop(self) -> None:
         import asyncio
@@ -203,19 +221,23 @@ class NativeRedisTransport:
 
     def _capture(self, n: int):
         """Snapshot the reusable batch buffers into a per-batch frame:
-        (key_blob, offsets, params[n, 4], cookie_gen, cookie_fd) — the
-        exact shape dispatch_wire_window consumes, with keys derived
-        lazily only on the fallback path."""
+        (key_blob, offsets, params[n, 4], cookie_gen, cookie_fd,
+        budgets[n]) — params is the exact shape dispatch_wire_window
+        consumes (the deadline column is split off as `budgets`), with
+        keys derived lazily only on the fallback path."""
         offsets = self._offsets[: n + 1].copy()
         # Copy only the used prefix, not the whole reusable buffer.
         blob = ctypes.string_at(self._key_buf, int(offsets[n]))
-        params = self._params[: 4 * n].reshape(n, 4).copy()
+        params5 = self._params[: 5 * n].reshape(n, 5)
+        params = params5[:, :4].copy()
+        budgets = params5[:, 4].copy()
         return (
             blob,
             offsets,
             params,
             self._cookie_gen[:n].copy(),
             self._cookie_fd[:n].copy(),
+            budgets,
         )
 
     def _keys_of(self, blob, offsets):
@@ -276,8 +298,10 @@ class NativeRedisTransport:
         never occupies the queue admission protects, so shedding it
         would turn a free exact denial into a 503 under exactly the
         abuse traffic this tier exists for.  Miss keys are marked
-        in-flight until observed."""
-        blob, offsets, params, gen, fd = batch
+        in-flight until observed.  Rows whose deadline budget expired
+        before pop are shed first (status 6) — the client stopped
+        waiting, so neither a cached denial nor a device row helps."""
+        blob, offsets, params, gen, fd, budgets = batch
         n = len(offsets) - 1
         front = self.front
         admission = front.admission
@@ -309,6 +333,13 @@ class NativeRedisTransport:
             )
             shed_norm: list = []
             for i in range(n):
+                if budgets[i] < 0:
+                    status_pre[i] = STATUS_DEADLINE
+                    if rows[i] is None:
+                        # The bulk lookup marked this miss in-flight;
+                        # it will never be observed, so free the hold.
+                        shed_norm.append(norm[i])
+                    continue
                 hit = rows[i]
                 if hit is not None:
                     status_pre[i] = 255  # marker: row served from cache
@@ -333,7 +364,9 @@ class NativeRedisTransport:
             # Admission-only config: no cache, so the per-row key
             # slices/decodes are never needed — shed or pass through.
             for i in range(n):
-                if admission is not None and not front.admit(
+                if budgets[i] < 0:
+                    status_pre[i] = STATUS_DEADLINE
+                elif admission is not None and not front.admit(
                     depth, q_col[i] == 0
                 ):
                     status_pre[i] = STATUS_OVERLOADED
@@ -363,6 +396,40 @@ class NativeRedisTransport:
             "hit_vals": hit_vals,
             "miss_idx": miss_idx,
             "miss_norm": miss_norm,
+            "miss_frame": miss_frame,
+            "miss_params": miss_params,
+        }
+
+    def _deadline_plan(self, batch):
+        """No-front-tier twin of _front_filter for batches carrying
+        expired rows: expired budgets answer status 6, live rows
+        compact into the device frame.  Same plan shape _merge_plan
+        consumes (no hits, no norm keys to observe)."""
+        blob, offsets, params, gen, fd, budgets = batch
+        n = len(offsets) - 1
+        expired = budgets < 0
+        status_pre = np.where(expired, STATUS_DEADLINE, 0).astype(np.uint8)
+        miss_idx = np.flatnonzero(~expired)
+        m = len(miss_idx)
+        if m == n:
+            miss_frame = (blob, offsets, params)
+            miss_params = params
+        elif m:
+            keys = [blob[offsets[i] : offsets[i + 1]] for i in miss_idx]
+            offsets_m = np.zeros(m + 1, np.int64)
+            np.cumsum([len(k) for k in keys], out=offsets_m[1:])
+            miss_params = np.ascontiguousarray(params[miss_idx])
+            miss_frame = (b"".join(keys), offsets_m, miss_params)
+        else:
+            miss_frame = None
+            miss_params = None
+        return {
+            "batch": batch,
+            "n": n,
+            "status_pre": status_pre,
+            "hit_vals": np.zeros((n, 5), np.int64),
+            "miss_idx": miss_idx,
+            "miss_norm": [],
             "miss_frame": miss_frame,
             "miss_params": miss_params,
         }
@@ -507,6 +574,7 @@ class NativeRedisTransport:
         use_front = front is not None and (
             front.deny_cache is not None or front.admission is not None
         )
+        n_expired = sum(int((b[5] < 0).sum()) for b in batches)
         if use_front:
             depth = int(self._lib.ws_queue_depth(self._h))
             plans = [
@@ -516,9 +584,17 @@ class NativeRedisTransport:
                 p["miss_frame"] for p in plans
                 if p["miss_frame"] is not None
             ]
+        elif n_expired:
+            plans = [self._deadline_plan(b) for b in batches]
+            frames = [
+                p["miss_frame"] for p in plans
+                if p["miss_frame"] is not None
+            ]
         else:
             plans = None
-            frames = [(b, o, p) for b, o, p, _, _ in batches]
+            frames = [(b, o, p) for b, o, p, _, _, _ in batches]
+        if n_expired and self.metrics is not None:
+            self.metrics.record_deadline_shed(n_expired)
         launched_n = sum(len(f[1]) - 1 for f in frames)
         t0 = time.monotonic()
         results, seq = self._decide_frames(frames, now_ns)
@@ -534,7 +610,7 @@ class NativeRedisTransport:
                 res = (
                     next(it) if plan["miss_frame"] is not None else None
                 )
-                if front.deny_cache is not None:
+                if front is not None and front.deny_cache is not None:
                     self._observe_plan(plan, res, now_ns, seq)
                 merged.append(self._merge_plan(plan, res))
             results = merged
@@ -549,7 +625,7 @@ class NativeRedisTransport:
             self.metrics is not None
             and self.metrics.top_denied is not None
         )
-        for (blob, offsets, _p, gen, fd), res in zip(batches, results):
+        for (blob, offsets, _p, gen, fd, _b), res in zip(batches, results):
             n_a, n_d, n_e, dk = self._respond_one(
                 blob, offsets, gen, fd, res, track_denied
             )
@@ -599,7 +675,7 @@ class NativeRedisTransport:
         rec = active_recorder()
         if rec is None:
             return
-        for (blob, offsets, params, _gen, _fd), res in zip(
+        for (blob, offsets, params, _gen, _fd, _budgets), res in zip(
             batches, results
         ):
             n = len(offsets) - 1
@@ -672,7 +748,10 @@ class NativeRedisTransport:
             self._lib.ws_set_metrics(self._h, text, len(text))
         from .supervisor import supervisor_state
 
-        state = supervisor_state(self.limiter)
+        if self._draining:
+            state = "draining"
+        else:
+            state = supervisor_state(self.limiter)
         body = b"OK" if state == "ok" else state.encode()
         self._lib.ws_set_health(self._h, body, len(body))
         if self.insight is not None:

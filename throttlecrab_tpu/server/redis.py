@@ -15,7 +15,12 @@ import asyncio
 import logging
 from typing import Optional
 
-from .engine import BatchingEngine, OverloadError, ThrottleError
+from .engine import (
+    BatchingEngine,
+    DeadlineError,
+    OverloadError,
+    ThrottleError,
+)
 from .metrics import Metrics
 from .transport_base import ConnTrackingMixin
 from .resp import (
@@ -179,8 +184,13 @@ class RedisTransport(ConnTrackingMixin):
         return Error("ERR wrong number of arguments for 'ping' command")
 
     async def _handle_throttle(self, args):
-        """redis/mod.rs:221-287."""
-        if not 5 <= len(args) <= 6:
+        """redis/mod.rs:221-287.
+
+        A 7th token (after quantity) is an optional client deadline in
+        milliseconds: `THROTTLE key burst count period quantity
+        deadline_ms`.  Expired-in-queue requests answer
+        `-ERR deadline exceeded` without a device launch."""
+        if not 5 <= len(args) <= 7:
             return Error(
                 "ERR wrong number of arguments for 'throttle' command"
             )
@@ -196,12 +206,21 @@ class RedisTransport(ConnTrackingMixin):
         period = _parse_integer(args[4])
         if period is None:
             return Error("ERR invalid period")
-        if len(args) == 6:
+        if len(args) >= 6:
             quantity = _parse_integer(args[5])
             if quantity is None:
                 return Error("ERR invalid quantity")
         else:
             quantity = 1
+        deadline_ns = None
+        if len(args) == 7:
+            deadline_ms = _parse_integer(args[6])
+            if deadline_ms is None:
+                return Error("ERR invalid deadline_ms")
+            if deadline_ms > 0:
+                deadline_ns = (
+                    self.engine.now_fn() + deadline_ms * 1_000_000
+                )
 
         request = ThrottleRequest(
             key=key,
@@ -209,12 +228,17 @@ class RedisTransport(ConnTrackingMixin):
             count_per_period=count_per_period,
             period=period,
             quantity=quantity,
+            deadline_ns=deadline_ns,
         )
         try:
             response = await self.engine.throttle(request)
         except OverloadError as e:
             # Shed by admission control; RESP has one error channel, so
             # the overload status is the distinguished message text.
+            return Error(f"ERR {e}")
+        except DeadlineError as e:
+            # Same single error channel: "deadline exceeded" is the
+            # distinguished timeout message.
             return Error(f"ERR {e}")
         except ThrottleError as e:
             return Error(f"ERR {e}")

@@ -18,6 +18,15 @@ class ConnTrackingMixin:
     def _init_conn_tracking(self) -> None:
         self._conn_tasks: set = set()
 
+    async def drain(self) -> None:
+        """Graceful-drain hook: stop accepting NEW connections while
+        established ones keep serving (they see OverloadError once the
+        engine drains; stop() later drops them).  No-op for transports
+        without a closable listener."""
+        server = getattr(self, "_server", None)
+        if server is not None:
+            server.close()
+
     def _track_conn(self):
         task = asyncio.current_task()
         self._conn_tasks.add(task)

@@ -15,13 +15,19 @@ NS_PER_SEC = 1_000_000_000
 
 @dataclass
 class ThrottleRequest:
-    """One rate-limit check (types.rs:32-45); timestamp is server-side."""
+    """One rate-limit check (types.rs:32-45); timestamp is server-side.
+
+    `deadline_ns` is the optional client deadline, absolute in the
+    engine's now_fn clock (None = no deadline — byte-identical legacy
+    behavior).  Requests still queued past it are shed at flush time,
+    before any device dispatch, with STATUS_DEADLINE semantics."""
 
     key: str
     max_burst: int
     count_per_period: int
     period: int
     quantity: int = 1
+    deadline_ns: int | None = None
 
 
 @dataclass

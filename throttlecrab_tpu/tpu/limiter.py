@@ -53,6 +53,11 @@ STATUS_INTERNAL = 3
 # limiter's namespace layer, parallel/tenants.py); the tenant's
 # existing keys keep deciding normally.
 STATUS_TENANT_QUOTA = 5
+# Request outlived its client deadline: shed host-side before device
+# dispatch (server/engine.py) or at a cluster hop (parallel/cluster.py).
+# Like 3/4, excluded from replay differentials — load-dependent, not a
+# GCRA outcome.
+STATUS_DEADLINE = 6
 
 
 def segment_info(slots, mask):
