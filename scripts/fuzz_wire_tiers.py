@@ -377,90 +377,129 @@ def run_hotkey_deny_seed(seed, steps):
 
 
 def run_cluster_frame_fuzz(seed, iters):
-    """Malformed-frame hardening for the elastic-cluster codecs
-    (OP_MIGRATE/OP_REPLICA rows, OP_RING weights, OP_ROUTE_BATCH,
-    OP_DROUTE_BATCH deadline routes, OP_LEAVE):
+    """Malformed-frame hardening for every elastic-cluster wire op:
     random truncations, byte flips and splices of valid frames must
     either decode cleanly or raise the typed ClusterProtocolError —
     never OverflowError/MemoryError/IndexError/struct.error, and never
-    size an allocation from an attacker-controlled count.  Returns the
-    number of frames exercised."""
+    size an allocation from an attacker-controlled count.
+
+    The mutation corpus is keyed off cluster.FRAME_DECODERS — the
+    protocol's single source of truth — with one maker arm per OP_*
+    constant.  A new op that lands without an arm here fails both the
+    runtime sync assert below and, structurally, the wire-surface
+    invariant checker (throttlecrab_tpu/analysis/wire_surface.py).
+    Returns the number of frames exercised."""
     from throttlecrab_tpu.parallel.cluster import (
+        FRAME_DECODERS,
+        OP_DROUTE_BATCH,
+        OP_JOIN,
+        OP_LEAVE,
         OP_MIGRATE,
         OP_REPLICA,
         OP_RING,
+        OP_RING_STATE,
+        OP_ROUTE_BATCH,
+        OP_THROTTLE_BATCH,
+        OP_THROTTLE_REPLY,
         ClusterProtocolError,
-        decode_batch,
-        decode_droute,
-        decode_leave,
-        decode_ring,
-        decode_route,
-        decode_rows,
         encode_batch,
         encode_droute,
+        encode_join,
         encode_leave,
+        encode_reply,
         encode_ring,
         encode_route,
         encode_rows,
     )
 
     rng = np.random.default_rng(seed)
-    decoders = {
-        "rows": decode_rows,
-        "ring": decode_ring,
-        "route": decode_route,
-        "batch": decode_batch,
-        "droute": decode_droute,
-        "leave": decode_leave,
-    }
-    done = 0
-    for _ in range(iters):
-        n = int(rng.integers(0, 12))
-        keys = [
+
+    def mk_keys(n):
+        return [
             bytes(rng.integers(0, 256, int(rng.integers(0, 40)),
                                dtype=np.uint8))
             for _ in range(n)
         ]
-        kind = ("rows", "ring", "route", "batch", "droute",
-                "leave")[int(rng.integers(6))]
-        if kind == "rows":
-            op = OP_MIGRATE if rng.random() < 0.5 else OP_REPLICA
-            frame = encode_rows(
-                op, int(rng.integers(0, 8)), int(rng.integers(0, 2**32)),
-                keys,
-                rng.integers(-(2**62), 2**62, n),
-                rng.integers(-(2**62), 2**62, n),
-            )
-        elif kind == "ring":
-            frame = encode_ring(
-                OP_RING, int(rng.integers(0, 2**32)),
-                rng.random(int(rng.integers(0, 8))).tolist(),
-            )
-        elif kind == "leave":
-            frame = encode_leave(
-                int(rng.integers(0, 256)), int(rng.integers(0, 2**32))
-            )
-        elif kind == "droute":
-            params = [
-                tuple(int(x) for x in rng.integers(-(2**40), 2**40, 4))
-                for _ in keys
-            ]
-            frame = encode_droute(
-                keys, params, int(rng.integers(0, 2**62)),
-                int(rng.integers(0, 8)),
-                rng.integers(-(2**62), 2**62, n),
-            )
-        else:
-            params = [
-                tuple(int(x) for x in rng.integers(-(2**40), 2**40, 4))
-                for _ in keys
-            ]
-            now = int(rng.integers(0, 2**62))
-            frame = (
-                encode_route(keys, params, now, int(rng.integers(0, 8)))
-                if kind == "route"
-                else encode_batch(keys, params, now)
-            )
+
+    def mk_params(n):
+        return [
+            tuple(int(x) for x in rng.integers(-(2**40), 2**40, 4))
+            for _ in range(n)
+        ]
+
+    def mk_rows(op):
+        n = int(rng.integers(0, 12))
+        return encode_rows(
+            op, int(rng.integers(0, 8)), int(rng.integers(0, 2**32)),
+            mk_keys(n),
+            rng.integers(-(2**62), 2**62, n),
+            rng.integers(-(2**62), 2**62, n),
+        )
+
+    def mk_ring(op):
+        return encode_ring(
+            op, int(rng.integers(0, 2**32)),
+            rng.random(int(rng.integers(0, 8))).tolist(),
+        )
+
+    def mk_batch(_op):
+        n = int(rng.integers(0, 12))
+        return encode_batch(
+            mk_keys(n), mk_params(n), int(rng.integers(0, 2**62))
+        )
+
+    def mk_route(_op):
+        n = int(rng.integers(0, 12))
+        return encode_route(
+            mk_keys(n), mk_params(n), int(rng.integers(0, 2**62)),
+            int(rng.integers(0, 8)),
+        )
+
+    def mk_droute(_op):
+        n = int(rng.integers(0, 12))
+        return encode_droute(
+            mk_keys(n), mk_params(n), int(rng.integers(0, 2**62)),
+            int(rng.integers(0, 8)),
+            rng.integers(-(2**62), 2**62, n),
+        )
+
+    def mk_reply(_op):
+        n = int(rng.integers(0, 12))
+        return encode_reply(
+            rng.integers(0, 7, n), rng.integers(0, 2, n),
+            rng.integers(-(2**62), 2**62, n),
+            rng.integers(-(2**62), 2**62, n),
+            rng.integers(0, 2**62, n), rng.integers(0, 2**62, n),
+        )
+
+    makers = {
+        OP_THROTTLE_BATCH: mk_batch,
+        OP_THROTTLE_REPLY: mk_reply,
+        OP_MIGRATE: mk_rows,
+        OP_RING: mk_ring,
+        OP_JOIN: lambda _op: encode_join(int(rng.integers(0, 256))),
+        OP_RING_STATE: mk_ring,
+        OP_REPLICA: mk_rows,
+        OP_ROUTE_BATCH: mk_route,
+        OP_LEAVE: lambda _op: encode_leave(
+            int(rng.integers(0, 256)), int(rng.integers(0, 2**32))
+        ),
+        OP_DROUTE_BATCH: mk_droute,
+    }
+    missing = set(FRAME_DECODERS) - set(makers)
+    extra = set(makers) - set(FRAME_DECODERS)
+    if missing or extra:
+        raise SystemExit(
+            f"fuzz arms out of sync with FRAME_DECODERS: "
+            f"missing={sorted(missing)} extra={sorted(extra)}"
+        )
+
+    ops = sorted(makers)
+    done = 0
+    for _ in range(iters):
+        op = ops[int(rng.integers(len(ops)))]
+        frame = makers[op](op)
+        decoder = FRAME_DECODERS[op][1]
         body = bytearray(frame[5:])  # strip _HDR, like the server does
         mode = rng.random()
         if mode < 0.35 and len(body):          # truncate
@@ -476,7 +515,7 @@ def run_cluster_frame_fuzz(seed, iters):
                              dtype=np.uint8)
             )
         try:
-            decoders[kind](bytes(body))
+            decoder(bytes(body))
         except ClusterProtocolError:
             pass  # the typed rejection the wire contract promises
         done += 1
@@ -496,16 +535,16 @@ def run_trace_frame_fuzz(seed, iters):
     import struct as _struct
 
     from throttlecrab_tpu.replay.trace import (
+        _DECODERS,
         Trace,
         TraceError,
         TraceWriter,
-        decode_event,
-        decode_injection,
-        decode_window,
     )
 
     rng = np.random.default_rng(seed)
-    frame_decoders = (decode_window, decode_event, decode_injection)
+    # Table-driven off the codec's own kind->decoder registry, so a new
+    # REC_* kind is fuzzed the moment it is wired into _DECODERS.
+    frame_decoders = tuple(fn for _, fn in sorted(_DECODERS.items()))
     done = 0
     for _ in range(iters):
         writer = TraceWriter()

@@ -11,7 +11,13 @@ Three layers:
     static_argnames, shape-based control flow) pass;
   * the round-5 regression — stripping the big-tolerance refusal from
     ``fits_w32_wire`` (the ADVICE round-5 high finding) must produce a
-    finding again.
+    finding again;
+  * the wave-3 protocol-surface family (wire / harden / status /
+    fault / ktwin) — real anchor files are copied into a temp tree and
+    mutated (an OP_* with no decoder, a decoder without the
+    trailing-bytes check, a STATUS_* absent from one transport, a
+    fault site with no hook, a flipped saturation predicate), and each
+    rule must fire with the right code and symbol.
 """
 
 from __future__ import annotations
@@ -26,16 +32,23 @@ import time
 from pathlib import Path
 
 from throttlecrab_tpu.analysis import (
+    CHECKER_CODES,
+    CHECKERS,
     DEFAULT_BASELINE,
     apply_baseline,
     load_baseline,
     run_all,
+    run_timed,
 )
 from throttlecrab_tpu.analysis import (
+    fault_surface,
     i64_hygiene,
     jit_boundary,
+    kernel_twins,
     registry,
+    status_surface,
     twin_drift,
+    wire_surface,
 )
 from throttlecrab_tpu.analysis.common import parse_baseline
 
@@ -1447,3 +1460,525 @@ class TestCliOutput:
         )
         assert proc.returncode == 1
         assert "runtime budget exceeded" in proc.stderr
+
+
+# ------------------------------------------------------------------ #
+# Wave 3: protocol-surface family (wire / harden / status / fault /
+# ktwin).  Each fixture copies the real anchor files into a temp tree
+# and mutates them — the mutation is the exact defect class the rule
+# exists to catch, so these double as regression pins for the rules.
+
+CLUSTER_REL = "throttlecrab_tpu/parallel/cluster.py"
+PAIRS_REL = "throttlecrab_tpu/tpu/pallas_fused.py"
+INJECTOR_REL = "throttlecrab_tpu/faults/injector.py"
+
+_WIRE_RELS = (
+    CLUSTER_REL,
+    "throttlecrab_tpu/replay/trace.py",
+    "throttlecrab_tpu/replay/player.py",
+    "scripts/fuzz_wire_tiers.py",
+)
+_STATUS_RELS = (
+    "throttlecrab_tpu/tpu/limiter.py",
+    "throttlecrab_tpu/front/admission.py",
+    "throttlecrab_tpu/server/engine.py",
+    "throttlecrab_tpu/server/http.py",
+    "throttlecrab_tpu/server/grpc.py",
+    "throttlecrab_tpu/server/redis.py",
+    "throttlecrab_tpu/server/native_redis.py",
+    "native/wire_server.cpp",
+)
+_FAULT_RELS = (
+    INJECTOR_REL,
+    CLUSTER_REL,
+    "throttlecrab_tpu/tpu/limiter.py",
+    "throttlecrab_tpu/tpu/snapshot.py",
+    "README.md",
+)
+_KTWIN_RELS = (
+    "throttlecrab_tpu/tpu/sat.py",
+    KERNEL_REL,
+    PAIRS_REL,
+)
+
+
+def _copy_tree(tmp_path: Path, rels) -> Path:
+    for rel in rels:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    return tmp_path
+
+
+def _mutate(root: Path, rel: str, old: str, new: str) -> None:
+    path = root / rel
+    src = path.read_text()
+    assert old in src, f"mutation anchor moved in {rel}: {old!r}"
+    path.write_text(src.replace(old, new))
+
+
+class TestWireSurface:
+    def test_real_tree_clean(self):
+        assert wire_surface.check_surface(REPO) == []
+
+    def test_fixture_tree_clean(self, tmp_path):
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        assert wire_surface.check_surface(root) == []
+
+    def test_op_without_decoder_fails_every_rung(self, tmp_path):
+        """A new OP_* constant with no FRAME_DECODERS entry must fail
+        the decoder, encoder, dispatch, and fuzzer rungs at once."""
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        path = root / CLUSTER_REL
+        path.write_text(path.read_text() + "\nOP_PING = 99\n")
+        codes = {
+            f.code
+            for f in wire_surface.check_surface(root)
+            if f.symbol == "OP_PING"
+        }
+        assert codes == {
+            "wire-decoder", "wire-encoder", "wire-dispatch", "wire-fuzz",
+        }
+
+    def test_missing_fuzzer_arm_flagged(self, tmp_path):
+        """Dropping one maker from the fuzzer's op-keyed table — the
+        exact OP_LEAVE/OP_DROUTE review-round gap — must fire."""
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        _mutate(
+            root, "scripts/fuzz_wire_tiers.py",
+            "        OP_RING: mk_ring,\n", "",
+        )
+        findings = wire_surface.check_surface(root)
+        assert any(
+            f.code == "wire-fuzz" and f.symbol == "OP_RING"
+            for f in findings
+        )
+
+    def test_unwired_table_entry_orphans_decoder(self, tmp_path):
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        _mutate(
+            root, CLUSTER_REL,
+            '    OP_ROUTE_BATCH: ("route", decode_route),\n', "",
+        )
+        findings = wire_surface.check_surface(root)
+        assert any(
+            f.code == "wire-decoder" and f.symbol == "OP_ROUTE_BATCH"
+            for f in findings
+        )
+        assert any(
+            f.code == "wire-orphan" and f.symbol == "decode_route"
+            for f in findings
+        )
+
+    def test_replayer_arm_loss_flagged(self, tmp_path):
+        """Renaming the player's cluster-leave arm orphans OP_LEAVE's
+        membership round-trip."""
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        _mutate(
+            root, "throttlecrab_tpu/replay/player.py",
+            'elif event.kind == "cluster-leave":',
+            'elif event.kind == "cluster-depart":',
+        )
+        findings = wire_surface.check_surface(root)
+        assert any(
+            f.code == "wire-replayer"
+            and f.symbol == "OP_LEAVE"
+            and f.path == "throttlecrab_tpu/replay/player.py"
+            for f in findings
+        )
+
+    def test_recorder_loss_flagged(self, tmp_path):
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        path = root / CLUSTER_REL
+        path.write_text(
+            path.read_text().replace(
+                'maybe_record_event("cluster-join"',
+                'maybe_record_event("cluster-joined"',
+            )
+        )
+        findings = wire_surface.check_surface(root)
+        assert any(
+            f.code == "wire-replayer"
+            and f.symbol == "OP_JOIN"
+            and f.path == CLUSTER_REL
+            for f in findings
+        )
+
+    def test_missing_anchor_is_loud(self, tmp_path):
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        (root / "throttlecrab_tpu/replay/trace.py").unlink()
+        findings = wire_surface.check_surface(root)
+        assert any(
+            f.code == "wire-missing"
+            and f.path == "throttlecrab_tpu/replay/trace.py"
+            for f in findings
+        )
+
+
+class TestDecodeHardening:
+    def test_real_tree_clean(self):
+        assert wire_surface.check_hardening(REPO) == []
+
+    def test_trailing_bytes_check_required(self, tmp_path):
+        """Stripping decode_batch's trailing-bytes rejection — the
+        defect this PR fixed — must fire harden-trailing."""
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        _mutate(
+            root, CLUSTER_REL,
+            "    if off != len(body):\n"
+            '        raise ClusterProtocolError'
+            '("trailing bytes after batch items")\n',
+            "",
+        )
+        findings = wire_surface.check_hardening(root)
+        assert any(
+            f.code == "harden-trailing" and f.symbol == "decode_batch"
+            for f in findings
+        )
+
+    def test_untyped_raise_flagged(self, tmp_path):
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        _mutate(
+            root, CLUSTER_REL,
+            'raise ClusterProtocolError("trailing bytes after batch items")',
+            'raise ValueError("trailing bytes after batch items")',
+        )
+        findings = wire_surface.check_hardening(root)
+        assert any(
+            f.code == "harden-typed" and f.symbol == "decode_batch"
+            for f in findings
+        )
+
+    def test_len_guard_before_unpack_required(self, tmp_path):
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        _mutate(
+            root, CLUSTER_REL,
+            "    if len(body) < _REQ_HEAD.size:\n"
+            '        raise ClusterProtocolError("short batch frame")\n',
+            "",
+        )
+        findings = wire_surface.check_hardening(root)
+        assert any(
+            f.code == "harden-guard" and f.symbol == "decode_batch"
+            for f in findings
+        )
+
+    def test_count_guard_before_allocation_required(self, tmp_path):
+        """An unpacked count sizing np.empty without its raise-guard is
+        the attacker-sized-allocation shape the RPC port must refuse."""
+        root = _copy_tree(tmp_path, _WIRE_RELS)
+        _mutate(
+            root, CLUSTER_REL,
+            "    if n > (len(body) - _REQ_HEAD.size) // min_item:\n"
+            "        raise ClusterProtocolError"
+            '(f"batch count {n} exceeds frame size")\n',
+            "",
+        )
+        findings = wire_surface.check_hardening(root)
+        assert any(
+            f.code == "harden-count" and f.symbol == "decode_batch"
+            for f in findings
+        )
+
+
+class TestStatusSurface:
+    def test_real_tree_clean(self):
+        assert status_surface.check(REPO) == []
+
+    def test_fixture_tree_clean(self, tmp_path):
+        root = _copy_tree(tmp_path, _STATUS_RELS)
+        assert status_surface.check(root) == []
+
+    def test_missing_message_entry_flagged(self, tmp_path):
+        root = _copy_tree(tmp_path, _STATUS_RELS)
+        _mutate(
+            root, "throttlecrab_tpu/server/engine.py",
+            '    STATUS_TENANT_QUOTA: "tenant capacity quota exceeded",\n',
+            "",
+        )
+        findings = status_surface.check(root)
+        assert any(
+            f.code == "status-message"
+            and f.symbol == "STATUS_TENANT_QUOTA"
+            for f in findings
+        )
+
+    def test_transport_arm_loss_flagged(self, tmp_path):
+        """An HTTP transport that stops catching OverloadError would
+        turn 503s into generic 500s — the hand-wired arm is pinned."""
+        root = _copy_tree(tmp_path, _STATUS_RELS)
+        path = root / "throttlecrab_tpu/server/http.py"
+        path.write_text(
+            path.read_text().replace("OverloadError", "OverloadGoneError")
+        )
+        findings = status_surface.check(root)
+        assert any(
+            f.code == "status-transport"
+            and f.symbol == "OverloadError"
+            and f.path == "throttlecrab_tpu/server/http.py"
+            for f in findings
+        )
+
+    def test_cpp_branch_loss_and_undeclared_value(self, tmp_path):
+        root = _copy_tree(tmp_path, _STATUS_RELS)
+        path = root / "native/wire_server.cpp"
+        path.write_text(
+            path.read_text().replace("status[i] == 5", "status[i] == 57")
+        )
+        findings = status_surface.check(root)
+        assert any(
+            f.code == "status-cpp" and f.symbol == "STATUS_TENANT_QUOTA"
+            for f in findings
+        )
+        assert any(
+            f.code == "status-cpp" and "57" in f.message
+            for f in findings
+        )
+
+    def test_native_driver_branch_required(self, tmp_path):
+        root = _copy_tree(tmp_path, _STATUS_RELS)
+        path = root / "throttlecrab_tpu/server/native_redis.py"
+        path.write_text(
+            path.read_text().replace("STATUS_DEADLINE", "STATUS_DEADLINE_X")
+        )
+        findings = status_surface.check(root)
+        codes = {
+            (f.code, f.symbol)
+            for f in findings
+            if f.code == "status-native"
+        }
+        assert ("status-native", "STATUS_DEADLINE") in codes
+        assert ("status-native", "STATUS_DEADLINE_X") in codes
+
+    def test_duplicate_status_value_is_orphan(self, tmp_path):
+        root = _copy_tree(tmp_path, _STATUS_RELS)
+        _mutate(
+            root, "throttlecrab_tpu/front/admission.py",
+            "STATUS_OVERLOADED = 4", "STATUS_OVERLOADED = 6",
+        )
+        findings = status_surface.check(root)
+        assert any(f.code == "status-orphan" for f in findings)
+
+
+class TestFaultSurface:
+    def test_real_tree_clean(self):
+        assert fault_surface.check(REPO) == []
+
+    def test_fixture_tree_clean(self, tmp_path):
+        root = _copy_tree(tmp_path, _FAULT_RELS)
+        assert fault_surface.check(root) == []
+
+    def test_declared_but_unarmed_site_flagged(self, tmp_path):
+        root = _copy_tree(tmp_path, _FAULT_RELS)
+        _mutate(
+            root, INJECTOR_REL,
+            '"snapshot", "migrate", "leave",',
+            '"snapshot", "migrate", "leave", "gremlin",',
+        )
+        findings = fault_surface.check(root)
+        assert any(
+            f.code == "fault-site" and f.symbol == "gremlin"
+            for f in findings
+        )
+        assert any(
+            f.code == "fault-doc" and f.symbol == "gremlin"
+            for f in findings
+        )
+
+    def test_typod_hook_site_flagged_both_directions(self, tmp_path):
+        """A typo'd site string at a hook call leaves the declared site
+        dead AND arms an undeclared one — both must fire."""
+        root = _copy_tree(tmp_path, _FAULT_RELS)
+        _mutate(
+            root, "throttlecrab_tpu/tpu/limiter.py",
+            'maybe_fail("keymap")', 'maybe_fail("keymapp")',
+        )
+        findings = fault_surface.check(root)
+        symbols = {
+            f.symbol for f in findings if f.code == "fault-site"
+        }
+        assert {"keymap", "keymapp"} <= symbols
+
+    def test_doc_row_removal_flagged(self, tmp_path):
+        root = _copy_tree(tmp_path, _FAULT_RELS)
+        readme = root / "README.md"
+        kept = [
+            line
+            for line in readme.read_text().splitlines()
+            if not line.startswith("| `migrate`")
+        ]
+        readme.write_text("\n".join(kept) + "\n")
+        findings = fault_surface.check(root)
+        assert any(
+            f.code == "fault-doc" and f.symbol == "migrate"
+            for f in findings
+        )
+
+    def test_mode_without_fire_arm_flagged(self, tmp_path):
+        root = _copy_tree(tmp_path, _FAULT_RELS)
+        _mutate(
+            root, INJECTOR_REL,
+            '"slow", "partial")', '"slow", "partial", "jitter")',
+        )
+        findings = fault_surface.check(root)
+        assert any(
+            f.code == "fault-mode" and f.symbol == "jitter"
+            for f in findings
+        )
+
+
+class TestKernelTwins:
+    def test_real_tree_clean(self):
+        assert kernel_twins.check(REPO) == []
+
+    def test_fixture_tree_clean(self, tmp_path):
+        root = _copy_tree(tmp_path, _KTWIN_RELS)
+        assert kernel_twins.check(root) == []
+
+    def test_saturation_predicate_drift_flagged(self, tmp_path):
+        """Flip one overflow predicate on the pair side of sat_add —
+        the IRs no longer match, so ktwin-drift must fire."""
+        root = _copy_tree(tmp_path, _KTWIN_RELS)
+        _mutate(
+            root, PAIRS_REL,
+            "pos_of = _is_pos(a) & _is_pos(b) & _is_neg(s)",
+            "pos_of = _is_pos(a) & _is_neg(b) & _is_neg(s)",
+        )
+        findings = kernel_twins.check(root)
+        assert any(
+            f.code == "ktwin-drift" and f.symbol == "_sat_add64"
+            for f in findings
+        )
+
+    def test_unmarked_sat_reaching_form_flagged(self, tmp_path):
+        root = _copy_tree(tmp_path, _KTWIN_RELS)
+        path = root / KERNEL_REL
+        path.write_text(
+            path.read_text()
+            + "\n\ndef sneaky_form(a, b):\n    return sat_add(a, b)\n"
+        )
+        findings = kernel_twins.check(root)
+        assert any(
+            f.code == "ktwin-unmarked" and f.symbol == "sneaky_form"
+            for f in findings
+        )
+
+    def test_empty_marker_reason_flagged(self, tmp_path):
+        root = _copy_tree(tmp_path, _KTWIN_RELS)
+        path = root / KERNEL_REL
+        path.write_text(
+            path.read_text()
+            + "\n\ndef probe_form(a, b):  # twin: xla-only()\n"
+            "    return sat_add(a, b)\n"
+        )
+        findings = kernel_twins.check(root)
+        assert any(
+            f.code == "ktwin-marker" and f.symbol == "probe_form"
+            for f in findings
+        )
+
+    def test_marker_with_reason_passes(self, tmp_path):
+        root = _copy_tree(tmp_path, _KTWIN_RELS)
+        path = root / KERNEL_REL
+        path.write_text(
+            path.read_text()
+            + "\n\ndef probe_form(a, b):"
+            "  # twin: xla-only(host-side scalar probe)\n"
+            "    return sat_add(a, b)\n"
+        )
+        assert kernel_twins.check(root) == []
+
+    def test_op_coverage_strip_flagged(self, tmp_path):
+        """Remove every _min64 from the pair transcription while the
+        XLA body still uses minimum — the coverage tier must fire."""
+        root = _copy_tree(tmp_path, _KTWIN_RELS)
+        path = root / PAIRS_REL
+        path.write_text(path.read_text().replace("_min64(", "_max64("))
+        findings = kernel_twins.check(root)
+        assert any(
+            f.code == "ktwin-coverage" and "_min64" in f.message
+            for f in findings
+        )
+
+    def test_vanished_manifest_twin_is_loud(self, tmp_path):
+        root = _copy_tree(tmp_path, _KTWIN_RELS)
+        _mutate(
+            root, PAIRS_REL,
+            "def _sat_add64(", "def _renamed_sat_add64(",
+        )
+        findings = kernel_twins.check(root)
+        assert any(
+            f.code == "ktwin-missing" and f.symbol == "_sat_add64"
+            for f in findings
+        )
+
+
+class TestWave3Registry:
+    def test_checker_codes_registry_total(self):
+        """Every registered checker declares its code prefixes — the
+        partial-run waiver filter depends on this map being total."""
+        assert set(CHECKER_CODES) == set(CHECKERS)
+        for name in ("wire", "harden", "status", "fault", "ktwin"):
+            assert name in CHECKER_CODES
+
+    def test_stale_wave3_waivers_ratchet(self):
+        """A waiver written against any wave-3 rule that matches no
+        finding must be reported stale — the new family ratchets from
+        zero exactly like the older checkers."""
+        from throttlecrab_tpu.analysis.common import Waiver
+
+        findings = run_all(REPO)
+        for code, path in (
+            ("wire-fuzz", CLUSTER_REL),
+            ("harden-trailing", CLUSTER_REL),
+            ("status-transport", "throttlecrab_tpu/server/http.py"),
+            ("fault-site", INJECTOR_REL),
+            ("ktwin-drift", PAIRS_REL),
+        ):
+            w = Waiver(code, path, symbol="ghost", reason="r")
+            unwaived, stale = apply_baseline(findings, [w])
+            assert stale == [w], f"{code} waiver did not ratchet"
+
+    def test_run_timed_rejects_unknown_checker(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="unknown checks"):
+            run_timed(REPO, checks={"nope"})
+
+    def test_cli_rejects_unknown_checks_with_roster(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO / "scripts" / "check_invariants.py"),
+                "--checks",
+                "nope",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 2
+        assert "unknown checks" in proc.stderr
+        assert "ktwin" in proc.stderr  # the valid roster is listed
+
+    def test_cli_wave3_partial_run_times_each_checker(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO / "scripts" / "check_invariants.py"),
+                "--json",
+                "--strict",
+                "--checks",
+                "wire,harden,status,fault,ktwin",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert set(report["checker_s"]) == {
+            "wire", "harden", "status", "fault", "ktwin",
+        }
+        assert report["findings"] == []
+        assert report["jax_imported"] is False

@@ -35,7 +35,26 @@ those invariants mechanically, on every PR, in seconds:
   * ``async``        — no threading lock held across ``await``, no
     ranked non-``async_ok`` lock or blocking call on the event loop
     outside ``run_in_executor``, no loop-affine asyncio API from
-    executor threads.
+    executor threads;
+  * ``wire``         — wire-frame exhaustiveness: every ``OP_*`` /
+    ``REC_*`` frame kind resolves to an encoder, a decoder table
+    entry, a dispatch arm, a fuzzer mutation arm, and (membership
+    ops) a replayer handler, with orphans in either direction;
+  * ``harden``       — the decode-hardening contract per ``decode_*``:
+    length guard before unpack, count-vs-size before allocation,
+    trailing-bytes rejection, typed errors only;
+  * ``status``       — status-taxonomy totality: every ``STATUS_*``
+    has its engine message, transport exception arms, native-driver
+    branches, and C++ responder branches (both directions);
+  * ``fault``        — fault-site registry: ``SITES``/``MODES``
+    bidirectionally consistent with the armed hook call sites, the
+    typed ``_site_error`` arms, the replay path, and the README
+    fault-site table;
+  * ``ktwin``        — kernel-twin contract: XLA closed forms and the
+    i32-pair library normalized into one op-DAG IR; structural pairs
+    must match, declared pairs must cite their twin, transcribed
+    bodies must cover every op kind, and anything else needs an
+    explicit ``# twin: xla-only(reason)`` marker.
 
 Pure stdlib, AST-based plus a small C++ token scanner: importing this
 package (or running ``scripts/check_invariants.py``) must never import
@@ -54,11 +73,15 @@ from .common import Finding, apply_baseline, load_baseline
 from . import (
     async_boundary,
     blocking,
+    fault_surface,
     i64_hygiene,
     jit_boundary,
+    kernel_twins,
     lock_order,
     registry,
+    status_surface,
     twin_drift,
+    wire_surface,
 )
 
 #: name -> check(root) callables, in report order.
@@ -70,7 +93,32 @@ CHECKERS = {
     "lock": lock_order.check,
     "block": blocking.check,
     "async": async_boundary.check,
+    "wire": wire_surface.check_surface,
+    "harden": wire_surface.check_hardening,
+    "status": status_surface.check,
+    "fault": fault_surface.check,
+    "ktwin": kernel_twins.check,
 }
+
+#: checker name -> the finding-code prefixes it emits.  The CLI uses
+#: this to scope baseline waivers on partial ``--checks`` runs; keeping
+#: it next to CHECKERS means registering a checker without declaring
+#: its codes is a KeyError at import time, not a silent waiver leak.
+CHECKER_CODES = {
+    "i64": ("i64",),
+    "twin": ("twin",),
+    "jit": ("jit",),
+    "registry": ("knob", "metric", "flag"),
+    "lock": ("lock",),
+    "block": ("block",),
+    "async": ("async",),
+    "wire": ("wire",),
+    "harden": ("harden",),
+    "status": ("status",),
+    "fault": ("fault",),
+    "ktwin": ("ktwin",),
+}
+assert set(CHECKER_CODES) == set(CHECKERS)
 
 DEFAULT_BASELINE = Path(__file__).with_name("baseline.toml")
 
@@ -80,8 +128,16 @@ def run_timed(
 ) -> Tuple[List[Finding], Dict[str, float]]:
     """Run the selected checkers (default: all); findings plus
     per-checker wall time (the CI budget assertion and ``--json``
-    timings both read it)."""
+    timings both read it).  Unknown checker names raise ValueError —
+    a typo'd programmatic selection must not silently run nothing."""
     root = Path(root)
+    if checks is not None:
+        unknown = set(checks) - set(CHECKERS)
+        if unknown:
+            raise ValueError(
+                f"unknown checks {sorted(unknown)}; "
+                f"available: {sorted(CHECKERS)}"
+            )
     findings: List[Finding] = []
     timings: Dict[str, float] = {}
     for name, fn in CHECKERS.items():
@@ -100,6 +156,7 @@ def run_all(root, checks=None) -> List[Finding]:
 
 __all__ = [
     "CHECKERS",
+    "CHECKER_CODES",
     "DEFAULT_BASELINE",
     "Finding",
     "apply_baseline",

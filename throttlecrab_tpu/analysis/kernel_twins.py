@@ -1,0 +1,406 @@
+"""Kernel-twin contract: XLA closed forms ↔ the i32-pair library.
+
+Every saturating closed form exists twice: once in native-i64 XLA
+(``tpu/sat.py``, consumed by ``tpu/kernel.py``) and once in i32-pair
+arithmetic (``tpu/pallas_fused.py``, where the fused Pallas kernel
+cannot use i64).  ROADMAP item 4 requires this twin relationship to be
+a decided contract *before* multi-algorithm rows multiply the twins
+unbounded.  This checker makes it mechanical by normalizing both sides
+into one small op-DAG IR (add/sub/mul/lt/eq/not/and/or/sel/max/min over
+vars and constants — ``a >= 0`` and ``~_is_neg(a)`` both canonicalize
+to ``not(lt(a, 0))``) and enforcing a three-tier manifest:
+
+  * STRUCTURAL pairs (``sat_add ↔ _sat_add64`` etc.) must normalize to
+    the *identical* IR — an edit to one side's overflow predicate that
+    is not mirrored is ``ktwin-drift``;
+  * DECLARED pairs (``sat_mul_nonneg ↔ _sat_mul_nonneg64``,
+    ``div_trunc ↔ _div_nonneg``) are intentionally different shapes
+    (the pair side replaces the i64 division overflow probe with a
+    128-bit product); the pair's docstring must name its XLA twin so
+    the deviation stays an audited decision (``ktwin-contract``);
+  * TRANSCRIBED bodies (``_request_outputs``/``_gcra_body`` ↔
+    ``_gcra_pairs``) are too large for IR equality; instead every
+    twin-mapped op kind the XLA body uses must have its pair
+    counterpart present in the pair body (``ktwin-coverage``) — a new
+    ``jnp.minimum`` lane on the XLA side with no ``_min64`` on the
+    pair side cannot land silently.
+
+Any other closed form that reaches the sat helpers must either join
+the manifest or carry an explicit ``# twin: xla-only(reason)`` marker
+on (or immediately above) its ``def`` line (``ktwin-unmarked``; an
+empty reason is ``ktwin-marker``).  ``ktwin-missing`` marks an
+unreadable anchor, a manifest name that vanished, or a body the
+normalizer cannot reduce — extraction failure is loud, never a silent
+pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from .common import Finding, PyModule, names_in
+
+MISSING = "ktwin-missing"
+DRIFT = "ktwin-drift"
+CONTRACT = "ktwin-contract"
+COVERAGE = "ktwin-coverage"
+UNMARKED = "ktwin-unmarked"
+MARKER = "ktwin-marker"
+
+SAT = "throttlecrab_tpu/tpu/sat.py"
+KERNEL = "throttlecrab_tpu/tpu/kernel.py"
+PAIRS = "throttlecrab_tpu/tpu/pallas_fused.py"
+
+#: XLA closed form -> pair twin that must normalize to the same IR.
+STRUCTURAL_PAIRS = {
+    "sat_add": "_sat_add64",
+    "sat_sub": "_sat_sub64",
+    "sat_add_nn": "_sat_add_nn64",
+    "sat_sub_nn": "_sat_sub_nn64",
+}
+
+#: XLA closed form -> pair twin that is a deliberately different shape;
+#: the pair docstring must name the XLA side.
+DECLARED_PAIRS = {
+    "sat_mul_nonneg": "_sat_mul_nonneg64",
+    "div_trunc": "_div_nonneg",
+}
+
+#: kernel.py decision bodies -> the pair transcription that must cover
+#: every twin-mapped op kind they use.
+TRANSCRIBED = {
+    "_request_outputs": "_gcra_pairs",
+    "_gcra_body": "_gcra_pairs",
+}
+
+#: op name on the XLA side -> required pair counterpart name.
+OP_TWINS = {
+    "sat_add": "_sat_add64",
+    "sat_sub": "_sat_sub64",
+    "sat_add_nn": "_sat_add_nn64",
+    "sat_sub_nn": "_sat_sub_nn64",
+    "sat_mul_nonneg": "_sat_mul_nonneg64",
+    "div_trunc": "_div_nonneg",
+    "where": "_sel64",
+    "maximum": "_max64",
+    "minimum": "_min64",
+}
+
+_MARKER = re.compile(r"#\s*twin:\s*xla-only\(([^)]*)\)")
+
+I64_MAX = (1 << 63) - 1
+I64_MIN = -(1 << 63)
+
+#: constant names both sides may reference.
+_CONSTS = {
+    "I64_MAX": I64_MAX,
+    "I64_MIN": I64_MIN,
+    "_I64MAX": I64_MAX,
+    "_I64MIN": I64_MIN,
+    "_ZERO64": 0,
+    "_ONE64": 1,
+}
+
+#: call name -> IR op for twin-mapped intrinsics (both sides).
+_CALL_OPS = {
+    "where": "sel",
+    "_sel64": "sel",
+    "maximum": "max",
+    "_max64": "max",
+    "minimum": "min",
+    "_min64": "min",
+    "_add64": "add",
+    "_sub64": "sub",
+    "_mul64": "mul",
+    "_lt64": "lt",
+    "_eq64": "eq",
+    "div": "div",
+    "_udiv64": "div",
+}
+
+
+class _Unnormalizable(Exception):
+    pass
+
+
+def _callee(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _norm(node: ast.AST, env: Dict[str, tuple]) -> tuple:
+    """Normalize one expression into the op-DAG IR (nested tuples)."""
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        if node.id in _CONSTS:
+            return ("const", _CONSTS[node.id])
+        raise _Unnormalizable(f"free name {node.id}")
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool) or not isinstance(
+            node.value, int
+        ):
+            raise _Unnormalizable(f"constant {node.value!r}")
+        return ("const", node.value)
+    if isinstance(node, ast.BinOp):
+        ops = {
+            ast.Add: "add", ast.Sub: "sub", ast.Mult: "mul",
+            ast.FloorDiv: "div", ast.BitAnd: "and", ast.BitOr: "or",
+        }
+        op = ops.get(type(node.op))
+        if op is None:
+            raise _Unnormalizable(type(node.op).__name__)
+        return (op, _norm(node.left, env), _norm(node.right, env))
+    if isinstance(node, ast.UnaryOp):
+        if isinstance(node.op, ast.Invert):
+            return ("not", _norm(node.operand, env))
+        if isinstance(node.op, ast.USub):
+            inner = _norm(node.operand, env)
+            if inner[0] == "const":
+                return ("const", -inner[1])
+        raise _Unnormalizable(type(node.op).__name__)
+    if isinstance(node, ast.Compare):
+        if len(node.ops) != 1:
+            raise _Unnormalizable("chained compare")
+        a = _norm(node.left, env)
+        b = _norm(node.comparators[0], env)
+        op = node.ops[0]
+        # canonical order: everything becomes lt / not(lt) / eq.
+        if isinstance(op, ast.Lt):
+            return ("lt", a, b)
+        if isinstance(op, ast.Gt):
+            return ("lt", b, a)
+        if isinstance(op, ast.GtE):
+            return ("not", ("lt", a, b))
+        if isinstance(op, ast.LtE):
+            return ("not", ("lt", b, a))
+        if isinstance(op, ast.Eq):
+            return ("eq", a, b)
+        if isinstance(op, ast.NotEq):
+            return ("not", ("eq", a, b))
+        raise _Unnormalizable(type(op).__name__)
+    if isinstance(node, ast.Call):
+        name = _callee(node)
+        args = [_norm(a, env) for a in node.args]
+        # pair predicates canonicalize to the same compares the XLA
+        # side writes natively.
+        if name == "_is_neg" and len(args) == 1:
+            return ("lt", args[0], ("const", 0))
+        if name == "_is_pos" and len(args) == 1:
+            return ("lt", ("const", 0), args[0])
+        if name == "_is_zero" and len(args) == 1:
+            return ("eq", args[0], ("const", 0))
+        if name == "_le64" and len(args) == 2:
+            return ("not", ("lt", args[1], args[0]))
+        op = _CALL_OPS.get(name)
+        if op is None:
+            raise _Unnormalizable(f"call {name}")
+        return (op, *args)
+    raise _Unnormalizable(type(node).__name__)
+
+
+def _normalize_function(fn: ast.FunctionDef) -> tuple:
+    """Symbolically evaluate a straight-line closed form to its return IR."""
+    env: Dict[str, tuple] = {
+        a.arg: ("var", i) for i, a in enumerate(fn.args.args)
+    }
+    body = fn.body
+    if (
+        body
+        and isinstance(body[0], ast.Expr)
+        and isinstance(body[0].value, ast.Constant)
+        and isinstance(body[0].value.value, str)
+    ):
+        body = body[1:]  # docstring
+    for stmt in body:
+        if isinstance(stmt, ast.Assign):
+            if len(stmt.targets) == 1 and isinstance(
+                stmt.targets[0], ast.Name
+            ):
+                env[stmt.targets[0].id] = _norm(stmt.value, env)
+                continue
+            raise _Unnormalizable("non-scalar assignment")
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            return _norm(stmt.value, env)
+        raise _Unnormalizable(type(stmt).__name__)
+    raise _Unnormalizable("no return")
+
+
+def _load(root: Path, rel: str, findings: List[Finding]) -> Optional[PyModule]:
+    try:
+        return PyModule.load(root, rel)
+    except (OSError, SyntaxError):
+        findings.append(Finding(MISSING, rel, 1, "anchor file unreadable"))
+        return None
+
+
+def _top_functions(mod: PyModule) -> Dict[str, ast.FunctionDef]:
+    return {
+        s.name: s
+        for s in mod.tree.body
+        if isinstance(s, ast.FunctionDef)
+    }
+
+
+def _marker_reason(
+    mod: PyModule, fn: ast.FunctionDef
+) -> Optional[Tuple[str, int]]:
+    """(reason, line) of a def-adjacent ``# twin: xla-only(...)``."""
+    for lineno in (fn.lineno, fn.lineno - 1):
+        if 1 <= lineno <= len(mod.lines):
+            m = _MARKER.search(mod.lines[lineno - 1])
+            if m:
+                return m.group(1), lineno
+    return None
+
+
+def check(root) -> List[Finding]:
+    root = Path(root)
+    findings: List[Finding] = []
+    sat = _load(root, SAT, findings)
+    kernel = _load(root, KERNEL, findings)
+    pairs = _load(root, PAIRS, findings)
+    if sat is None or pairs is None:
+        return findings
+
+    sat_fns = _top_functions(sat)
+    pair_fns = _top_functions(pairs)
+    kernel_fns = _top_functions(kernel) if kernel is not None else {}
+
+    def require(
+        fns: Dict[str, ast.FunctionDef], rel: str, name: str, twin: str
+    ) -> Optional[ast.FunctionDef]:
+        fn = fns.get(name)
+        if fn is None:
+            findings.append(
+                Finding(
+                    MISSING, rel, 1,
+                    f"manifest function {name} not found "
+                    f"(twin of {twin})",
+                    symbol=name,
+                )
+            )
+        return fn
+
+    # ---- structural pairs: identical op-DAG IR -------------------- #
+    for xla_name, pair_name in sorted(STRUCTURAL_PAIRS.items()):
+        xf = require(sat_fns, SAT, xla_name, pair_name)
+        pf = require(pair_fns, PAIRS, pair_name, xla_name)
+        if xf is None or pf is None:
+            continue
+        irs = {}
+        for rel, fn in ((SAT, xf), (PAIRS, pf)):
+            try:
+                irs[rel] = _normalize_function(fn)
+            except _Unnormalizable as e:
+                findings.append(
+                    Finding(
+                        MISSING, rel, fn.lineno,
+                        f"{fn.name} not normalizable to the twin IR "
+                        f"({e})",
+                        symbol=fn.name,
+                    )
+                )
+        if len(irs) == 2 and irs[SAT] != irs[PAIRS]:
+            findings.append(
+                Finding(
+                    DRIFT, PAIRS, pf.lineno,
+                    f"{pair_name} IR diverges from its XLA twin "
+                    f"{xla_name} — the saturation predicates no "
+                    f"longer match",
+                    symbol=pair_name,
+                )
+            )
+
+    # ---- declared pairs: exist + docstring names the twin --------- #
+    for xla_name, pair_name in sorted(DECLARED_PAIRS.items()):
+        require(sat_fns, SAT, xla_name, pair_name)
+        pf = require(pair_fns, PAIRS, pair_name, xla_name)
+        if pf is None:
+            continue
+        doc = ast.get_docstring(pf) or ""
+        if xla_name not in doc:
+            findings.append(
+                Finding(
+                    CONTRACT, PAIRS, pf.lineno,
+                    f"{pair_name} is a declared (shape-divergent) twin "
+                    f"but its docstring does not name {xla_name}",
+                    symbol=pair_name,
+                )
+            )
+
+    # ---- transcribed bodies: op-kind coverage --------------------- #
+    for xla_name, pair_name in sorted(TRANSCRIBED.items()):
+        xf = kernel_fns.get(xla_name)
+        if xf is None:
+            if kernel is not None:
+                findings.append(
+                    Finding(
+                        MISSING, KERNEL, 1,
+                        f"manifest function {xla_name} not found "
+                        f"(transcribed into {pair_name})",
+                        symbol=xla_name,
+                    )
+                )
+            continue
+        pf = require(pair_fns, PAIRS, pair_name, xla_name)
+        if pf is None:
+            continue
+        used = names_in(xf)
+        have = names_in(pf)
+        for op in sorted(used & set(OP_TWINS)):
+            twin = OP_TWINS[op]
+            if twin not in have:
+                findings.append(
+                    Finding(
+                        COVERAGE, PAIRS, pf.lineno,
+                        f"{xla_name} uses {op} but {pair_name} never "
+                        f"references its pair twin {twin}",
+                        symbol=pair_name,
+                    )
+                )
+
+    # ---- every other sat-reaching closed form is marked ----------- #
+    manifest = (
+        set(STRUCTURAL_PAIRS) | set(DECLARED_PAIRS) | set(TRANSCRIBED)
+    )
+    sat_helper_names = set(sat_fns)
+    scope: List[Tuple[PyModule, ast.FunctionDef]] = [
+        (sat, fn) for fn in sat_fns.values()
+    ]
+    if kernel is not None:
+        scope += [
+            (kernel, fn)
+            for fn in kernel_fns.values()
+            if names_in(fn) & sat_helper_names
+        ]
+    for mod, fn in scope:
+        if fn.name in manifest:
+            continue
+        marker = _marker_reason(mod, fn)
+        if marker is None:
+            findings.append(
+                Finding(
+                    UNMARKED, mod.rel, fn.lineno,
+                    f"{fn.name} reaches the sat closed forms but has "
+                    f"no pair twin in the manifest and no "
+                    f"'# twin: xla-only(reason)' marker",
+                    symbol=fn.name,
+                )
+            )
+        elif not marker[0].strip():
+            findings.append(
+                Finding(
+                    MARKER, mod.rel, marker[1],
+                    f"{fn.name}: xla-only marker has an empty reason",
+                    symbol=fn.name,
+                )
+            )
+    return findings

@@ -211,6 +211,8 @@ def decode_batch(body: bytes):
         off += klen
         params[i] = _REQ_ITEM.unpack_from(body, off)
         off += _REQ_ITEM.size
+    if off != len(body):
+        raise ClusterProtocolError("trailing bytes after batch items")
     return keys, params, now_ns
 
 
@@ -377,6 +379,27 @@ def decode_droute(body: bytes):
     if len(keys) != n:
         raise ClusterProtocolError("droute count mismatches batch")
     return hops, keys, params, now_ns, budgets
+
+
+#: op -> (frame-kind name, decoder): the wire protocol's single source
+#: of truth.  The frame fuzzer (scripts/fuzz_wire_tiers.py) builds its
+#: mutation corpus off this table at runtime and the wire-surface
+#: invariant checker (throttlecrab_tpu/analysis/wire_surface.py) parses
+#: it structurally, so an OP_* constant that is not wired here — or an
+#: entry whose decoder has gone away — fails
+#: `scripts/check_invariants.py --strict` instead of shipping half-wired.
+FRAME_DECODERS = {
+    OP_THROTTLE_BATCH: ("batch", decode_batch),
+    OP_THROTTLE_REPLY: ("reply", decode_reply),
+    OP_MIGRATE: ("migrate", decode_rows),
+    OP_RING: ("ring", decode_ring),
+    OP_JOIN: ("join", decode_join),
+    OP_RING_STATE: ("ring-state", decode_ring),
+    OP_REPLICA: ("replica", decode_rows),
+    OP_ROUTE_BATCH: ("route", decode_route),
+    OP_LEAVE: ("leave", decode_leave),
+    OP_DROUTE_BATCH: ("droute", decode_droute),
+}
 
 
 class PeerUnavailable(ConnectionError):
