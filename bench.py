@@ -219,6 +219,17 @@ def main() -> int:
     ap.add_argument("--replay-trace", default="",
                     help="with --replay: replay this trace file "
                          "instead of synthesizing one")
+    ap.add_argument("--checkpoint", action="store_true",
+                    help="crash-durability A/B instead (ISSUE 19): one "
+                         "flash-crowd trace replayed against the same "
+                         "limiter config with checkpointing OFF vs a "
+                         "Checkpointer marking every decided window "
+                         "dirty and writing a durable generation every "
+                         "8 windows; verifies the outcome vectors are "
+                         "bit-identical first (persistence rides the "
+                         "observe path only), then reports the "
+                         "decision-throughput overhead and bytes "
+                         "written")
     ap.add_argument("--control", action="store_true",
                     help="control-plane A/B instead (ISSUE 16): one "
                          "flash-crowd trace simulated under virtual "
@@ -278,6 +289,8 @@ def main() -> int:
         return run_cluster_bench(args)
     if args.replay:
         return run_replay_bench(args, device)
+    if args.checkpoint:
+        return run_checkpoint_bench(args, device)
     if args.control:
         return run_control_bench(args, device)
     pallas_interpreted = args.pallas and device.platform != "tpu"
@@ -831,6 +844,131 @@ def run_replay_bench(args, device) -> int:
                 "insight_on": round(rate_on),
                 "unit": "decisions/s",
                 "overhead_frac": round(1.0 - rate_on / rate_off, 4),
+                "outcomes_bit_identical": identical,
+                "platform": device.platform,
+            }
+        )
+    )
+    return 0 if identical else 1
+
+
+def run_checkpoint_bench(args, device) -> int:
+    """Crash-durability same-session A/B (ISSUE 19): one trace —
+    synthetic flash-crowd by default, or any recorded trace via
+    --replay-trace — replayed with checkpointing off vs on in one
+    session.
+
+    The on side mirrors the server wiring: every decided window's keys
+    are marked dirty (the engine's post-decision observe path) and a
+    durable generation — encode, fsync, rename, directory fsync — is
+    written every 8 windows.  Replay first PROVES the outcome vectors
+    bit-identical (persistence only ever exports; it cannot shift a
+    decision), then times each side over the identical stream, so the
+    reported overhead isolates dirty-marking + the periodic durable
+    write.  Same-session, same trace: the controlled-variable shape
+    docs/benchmark-results.md prescribes."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from throttlecrab_tpu.persist import Checkpointer
+    from throttlecrab_tpu.replay.generators import synthesize
+    from throttlecrab_tpu.replay.player import (
+        _decode_keys,
+        outcome_vector,
+    )
+    from throttlecrab_tpu.replay.trace import Trace
+    from throttlecrab_tpu.tpu.limiter import TpuRateLimiter
+
+    if args.replay_trace:
+        trace = Trace.load(args.replay_trace)
+        source = args.replay_trace
+    else:
+        trace = synthesize(
+            "flash-crowd",
+            windows=24 if args.quick else 96,
+            batch=512 if args.quick else 2048,
+            key_space=4096 if args.quick else 32768,
+            seed=17,
+        )
+        source = "synthetic flash-crowd"
+    cap = 1 << 17
+    every = 8
+
+    def _replay(limiter, ck):
+        """replay/player.replay with the server's persistence hooks:
+        the same loop for both sides so the A/B isolates the hooks."""
+        out = []
+        for i, w in enumerate(trace.windows):
+            keys = _decode_keys(w.keys, limiter)
+            res = limiter.rate_limit_batch(
+                keys,
+                w.params[:, 0], w.params[:, 1], w.params[:, 2],
+                w.params[:, 3], w.now_ns,
+            )
+            out.append((
+                np.asarray(res.allowed, np.uint8).copy(),
+                np.asarray(res.status, np.uint8).copy(),
+            ))
+            if ck is not None:
+                ck.note_keys(keys)
+                if (i + 1) % every == 0:
+                    ck.checkpoint_now(w.now_ns)
+        return out
+
+    def measure(checkpoint: bool):
+        ckdir = tempfile.mkdtemp(prefix="tc-bench-ck-")
+        try:
+            def build():
+                limiter = TpuRateLimiter(capacity=cap, keymap="python")
+                ck = None
+                if checkpoint:
+                    ck = Checkpointer(
+                        limiter, ckdir, interval_ns=1 << 62
+                    )
+                return limiter, ck
+
+            limiter, ck = build()
+            vec = outcome_vector(_replay(limiter, ck))  # warm pass
+            shutil.rmtree(ckdir, ignore_errors=True)
+            limiter2, ck2 = build()
+            t0 = time.perf_counter()
+            _replay(limiter2, ck2)
+            elapsed = time.perf_counter() - t0
+            stats = {"generations": 0, "bytes": 0}
+            if ck2 is not None:
+                stats["generations"] = ck2.checkpoints_total
+                stats["bytes"] = sum(
+                    p.stat().st_size
+                    for p in Path(ckdir).glob("*.tck")
+                )
+            return trace.n_rows() / elapsed, vec, stats
+        finally:
+            shutil.rmtree(ckdir, ignore_errors=True)
+
+    rate_off, vec_off, _ = max(
+        (measure(False) for _ in range(2)), key=lambda rv: rv[0]
+    )
+    rate_on, vec_on, ck_stats = max(
+        (measure(True) for _ in range(2)), key=lambda rv: rv[0]
+    )
+    identical = vec_off == vec_on
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "checkpoint A/B decisions/s (one trace, durability "
+                    f"off vs on, same session; {source}, "
+                    f"{len(trace.windows)} windows, "
+                    f"{trace.n_rows()} rows, one generation per "
+                    f"{every} windows)"
+                ),
+                "checkpoint_off": round(rate_off),
+                "checkpoint_on": round(rate_on),
+                "unit": "decisions/s",
+                "overhead_frac": round(1.0 - rate_on / rate_off, 4),
+                "generations_written": ck_stats["generations"],
+                "checkpoint_bytes": ck_stats["bytes"],
                 "outcomes_bit_identical": identical,
                 "platform": device.platform,
             }

@@ -400,6 +400,93 @@ def test_rejoin_migrates_absorbed_state_back():
                     pass
 
 
+def test_crash_rejoin_restores_checkpoint_then_reconciles(tmp_path):
+    """Crash-rejoin with durability: the restarted node restores its
+    local checkpoint BEFORE announcing, then the successor's
+    migrate-back reconciles per key newest-wins — inbound rows that are
+    not newer than the restored local row are counted and dropped, and
+    a key only the checkpoint knew (never replicated, never absorbed)
+    keeps its spent budget across the crash."""
+    from throttlecrab_tpu.persist import Checkpointer, recover_into
+    from throttlecrab_tpu.tpu.snapshot import export_state
+
+    ports = free_ports(2)
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+    a = Node(0, nodes)
+    b = Node(1, nodes)
+    b2 = None
+    try:
+        a.join_cluster()
+        b.join_cluster()
+        ring = a.cl.ring
+        gen = (k for k in (f"cj:{i}" for i in range(8000))
+               if ring.owner_of(k.encode()) == 1)
+        hot, cold = next(gen), next(gen)
+        now = T0
+        # hot: exhausted on B and replicated to A (the takeover path).
+        now = exhaust_key(b, hot, now)
+        deadline = time.monotonic() + 5
+        while (
+            time.monotonic() < deadline
+            and hot.encode() not in a.cl.replica_store
+        ):
+            time.sleep(0.1)
+        # cold: 1 of burst 2 spent on B, then checkpointed.  Replication
+        # may or may not have pushed it by the kill — the checkpoint is
+        # what guarantees the spend survives.
+        res = b.cl.rate_limit_batch([cold], 2, 2, 600, 1, now)
+        assert res.status[0] == 0 and res.allowed[0]
+        ck = Checkpointer(b.limiter, tmp_path, interval_ns=1 << 62)
+        assert ck.checkpoint_now(now, force_base=True) >= 2
+        b.kill()
+        # A serves hot during the outage from the absorbed replica.
+        res = a.cl.rate_limit_batch([hot], 2, 2, 600, 1, now + 1)
+        assert res.status[0] == 0 and not res.allowed[0]
+        # B restarts on the same disk: restore the chain FIRST (into a
+        # swept-empty table), then announce.
+        b2 = Node(1, nodes)
+        b2.limiter.sweep(1 << 62)  # clear the constructor's warm-up row
+        rres = recover_into(b2.cl, tmp_path, now + 2)
+        assert rres is not None and rres.restored >= 2
+        b2.join_cluster()
+        settle_handoffs(a, b2)
+        # hot: migrate-back (same-or-newer than the checkpoint) kept it
+        # denied — no re-allow from the crash.
+        res = b2.cl.rate_limit_batch([hot], 2, 2, 600, 1, now + 3)
+        assert res.status[0] == 0 and not res.allowed[0]
+        # cold: the checkpointed spend survived — exactly one token
+        # left, not a fresh bucket.
+        res = b2.cl.rate_limit_batch([cold], 2, 2, 600, 1, now + 3)
+        assert res.status[0] == 0 and res.allowed[0]
+        res = b2.cl.rate_limit_batch([cold], 2, 2, 600, 1, now + 4)
+        assert res.status[0] == 0 and not res.allowed[0]
+        # Newest-wins reconcile, directly: replay a STALE inbound row
+        # for cold (older TAT than the live local row).  It must be
+        # counted + dropped, never clobber the newer local state.
+        k_col, _s, _sh, t_col, _e, _c, _d = export_state(b2.cl.local)
+        rows = {k: int(t_col[i]) for i, k in enumerate(k_col)}
+        cold_local = rows[
+            cold if cold in rows else cold.encode()
+        ]
+        stale_before = b2.cl.reconciled_stale
+        b2.cl.apply_migrate(
+            0, b2.cl.epoch, [cold.encode()], [cold_local - 1], [now + 600 * NS]
+        )
+        assert b2.cl.reconciled_stale == stale_before + 1
+        assert b2.cl.cluster_view()["reconciled_stale"] >= 1
+        res = b2.cl.rate_limit_batch([cold], 2, 2, 600, 1, now + 5)
+        assert res.status[0] == 0 and not res.allowed[0], (
+            "stale migrate-back clobbered the newer restored row"
+        )
+    finally:
+        for n in (a, b, b2):
+            if n is not None:
+                try:
+                    n.kill()
+                except Exception:
+                    pass
+
+
 def test_wire_window_fast_path_feeds_replication():
     """The native transports' dispatch_wire_window fast path decides
     exactly the locally-owned rows warm replication exists to protect;

@@ -1818,7 +1818,8 @@ class TestFaultSurface:
         root = _copy_tree(tmp_path, _FAULT_RELS)
         _mutate(
             root, INJECTOR_REL,
-            '"slow", "partial")', '"slow", "partial", "jitter")',
+            '"truncate", "fsyncfail",',
+            '"truncate", "fsyncfail", "jitter",',
         )
         findings = fault_surface.check(root)
         assert any(

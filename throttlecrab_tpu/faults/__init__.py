@@ -12,11 +12,15 @@ from .injector import (  # noqa: F401  (re-exported API)
     SITES,
     FaultInjector,
     FaultSpec,
+    FsyncFailError,
     InjectedDeviceError,
     PartialWriteError,
+    TruncatedWriteError,
     active_injector,
     arm,
     disarm,
+    file_write_with_faults,
+    fsync_with_faults,
     maybe_fail,
     parse_spec,
     send_with_faults,

@@ -30,6 +30,16 @@ operation (a congested/slow peer) and then lets it proceed, and the
 failing, so the receiver observes a genuinely truncated frame and must
 drop the connection to resynchronize.
 
+Durability realism (the ``snapshot`` site): ``truncate:<frac>`` — at
+file-write chokepoints routed through :func:`file_write_with_faults` —
+puts a *prefix* of the payload on disk before failing, the torn-write
+shape a crash leaves behind on ext4/xfs when the rename is journaled
+before the data blocks land; ``fsyncfail`` raises at the
+:func:`fsync_with_faults` chokepoint, the EIO-on-fsync failure that
+makes "written" files vanish on power loss.  Both degrade to a clean
+``OSError`` at sites/hooks with no file to tear (the same discipline
+as ``partial`` on the socket receive side).
+
 Determinism: probability draws come from a per-fault 64-bit LCG seeded
 from the spec, never from ``random``/wall clock, so a chaos run replays
 bit-identically.  ``hang`` sleeps through an injectable ``sleep_fn`` so
@@ -51,7 +61,10 @@ from typing import Dict, List, Optional, Sequence
 SITES = (
     "launch", "fetch", "peer", "keymap", "snapshot", "migrate", "leave",
 )
-MODES = ("transient", "persistent", "count", "hang", "slow", "partial")
+MODES = (
+    "transient", "persistent", "count", "hang", "slow", "partial",
+    "truncate", "fsyncfail",
+)
 
 
 class InjectedDeviceError(RuntimeError):
@@ -73,6 +86,30 @@ class PartialWriteError(ConnectionError):
     connection failure; :func:`send_with_faults` catches it at sender
     chokepoints to actually truncate the frame on the wire first.
     """
+
+
+class TruncatedWriteError(OSError):
+    """A fired ``truncate`` file mode.
+
+    An OSError subclass so sites that only ``maybe_fail`` (no payload
+    in hand) degrade to a clean I/O failure;
+    :func:`file_write_with_faults` catches it at file-write chokepoints
+    to actually put a prefix of the payload on disk first — the torn
+    file a crash mid-write leaves behind.
+    """
+
+    def __init__(self, frac: float) -> None:
+        super().__init__(
+            f"injected torn write (first {frac:.0%} of payload on disk)"
+        )
+        self.frac = frac
+
+
+class FsyncFailError(OSError):
+    """A fired ``fsyncfail`` mode: fsync raises before durability is
+    promised — the EIO-on-fsync shape that makes "written" data vanish
+    on power loss.  An OSError subclass so every snapshot-site caller
+    already handles it."""
 
 
 def _site_error(site: str, detail: str) -> Exception:
@@ -110,7 +147,10 @@ def parse_spec(text: str) -> List[FaultSpec]:
     ``hang:seconds`` (the check stalls, then passes), ``slow:seconds``
     (socket sites: the operation stalls like a congested peer, then
     proceeds), ``partial`` (socket sender sites: a prefix of the frame
-    reaches the wire before the connection fails).
+    reaches the wire before the connection fails), ``truncate:frac``
+    (file-write sites: the first ``frac`` of the payload lands on disk
+    before the write fails — a torn write), ``fsyncfail`` (fsync
+    chokepoints raise before durability is promised).
     """
     specs: List[FaultSpec] = []
     for raw in text.split(","):
@@ -135,12 +175,14 @@ def parse_spec(text: str) -> List[FaultSpec]:
                 arg = float(parts[2])
             except ValueError as e:
                 raise ValueError(f"bad fault arg in {raw!r}: {e}") from e
-        elif mode in ("transient", "count", "hang", "slow"):
+        elif mode in ("transient", "count", "hang", "slow", "truncate"):
             raise ValueError(f"fault mode {mode!r} requires an arg")
         if mode == "transient" and not 0.0 <= arg <= 1.0:
             raise ValueError("transient probability must be in [0, 1]")
         if mode in ("count", "hang", "slow") and arg < 0:
             raise ValueError(f"fault arg must be >= 0 in {raw!r}")
+        if mode == "truncate" and not 0.0 < arg < 1.0:
+            raise ValueError("truncate fraction must be in (0, 1)")
         specs.append(FaultSpec(site, mode, arg))
     return specs
 
@@ -209,6 +251,16 @@ class _Armed:
             raise PartialWriteError(
                 f"injected {spec.site} partial write (connection lost "
                 "mid-frame)"
+            )
+        elif spec.mode == "truncate":
+            self.fired += 1
+            note_fired(spec.site, spec.mode, index, spec.arg)
+            raise TruncatedWriteError(spec.arg)
+        elif spec.mode == "fsyncfail":
+            self.fired += 1
+            note_fired(spec.site, spec.mode, index, spec.arg)
+            raise FsyncFailError(
+                f"injected {spec.site} fsync failure (durability lost)"
             )
 
 
@@ -368,3 +420,35 @@ def send_with_faults(site: str, sock, frame: bytes) -> None:
                 pass
             raise
     sock.sendall(frame)
+
+
+def file_write_with_faults(site: str, fileobj, data: bytes) -> None:
+    """File-write chokepoint: checks `site` like maybe_fail, then
+    writes `data` — but a fired ``truncate`` mode puts the leading
+    fraction of the payload on disk and fails, so the file is
+    genuinely torn (short body, stale CRC) rather than cleanly absent.
+    Callers that rename-into-place on success should, on this error,
+    decide whether the torn bytes model a pre-rename crash (tmp file
+    left behind) or a post-rename one (torn final file)."""
+    if _active is not None:
+        try:
+            _active.check(site)
+        except TruncatedWriteError as e:
+            try:
+                fileobj.write(data[: max(1, int(len(data) * e.frac))])
+                fileobj.flush()
+            except OSError:
+                pass
+            raise
+    fileobj.write(data)
+
+
+def fsync_with_faults(site: str, fd: int) -> None:
+    """fsync chokepoint: checks `site` like maybe_fail (a fired
+    ``fsyncfail`` raises here, *before* durability is promised), then
+    fsyncs `fd` for real."""
+    import os
+
+    if _active is not None:
+        _active.check(site)
+    os.fsync(fd)

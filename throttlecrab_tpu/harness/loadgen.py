@@ -21,7 +21,12 @@ import time
 from dataclasses import dataclass, field
 from typing import List
 
-from .workload import Workload, flash_crowd_hot_sets, make_keys
+from .workload import (
+    Workload,
+    crash_restart_ledger,
+    flash_crowd_hot_sets,
+    make_keys,
+)
 
 #: Sentinel outcome a client returns when the server shed the request
 #: for a lapsed deadline (HTTP 504 / RESP -ERR deadline exceeded /
@@ -131,6 +136,13 @@ class PerfResult:
     # patterns (noisy-neighbor), so tenant isolation is a measured,
     # replayable scenario rather than a one-off test.
     tenant_counts: dict = field(default_factory=dict, repr=False)
+    # Warm-restart ledger (--key-pattern crash-restart): cumulative
+    # allows per fixed ledger key.  A restart that comes back cold
+    # grants each exhausted key a fresh bucket, so allows past one
+    # burst count exactly the state the restart forgot; zero extras
+    # means the checkpoint restore was fully warm.
+    ledger_counts: dict = field(default_factory=dict, repr=False)
+    ledger_burst: int = 0
 
     def track_tenant(self, key: str, allowed) -> None:
         tenant = key.split(":", 1)[0] if ":" in key else "(default)"
@@ -161,6 +173,33 @@ class PerfResult:
                 "deny_rate": round(d / total, 4) if total else 0.0,
             }
         return out
+
+    def track_ledger(self, key: str, allowed) -> None:
+        if allowed:
+            self.ledger_counts[key] = self.ledger_counts.get(key, 0) + 1
+
+    def warm_start_summary(self) -> dict:
+        """{ledger_keys, keys_over_burst, extra_allows_total, ...} —
+        the crash-restart audit.  keys_over_burst == 0 means no ledger
+        key was ever granted more than one full bucket across every
+        kill/restart in the run (the restore carried its TAT); each
+        cold restart would add up to a full burst per exhausted key to
+        extra_allows_total."""
+        burst = self.ledger_burst
+        over = {
+            k: c for k, c in self.ledger_counts.items() if c > burst
+        }
+        return {
+            "ledger_keys": len(self.ledger_counts),
+            "ledger_burst": burst,
+            "keys_over_burst": len(over),
+            "extra_allows_total": sum(
+                c - burst for c in over.values()
+            ),
+            "max_allows_per_key": max(
+                self.ledger_counts.values(), default=0
+            ),
+        }
 
     def track_stall(self, t_s: float, ok: bool) -> None:
         """Feed per-request completion times (any worker): a success
@@ -619,6 +658,10 @@ async def run_perf_test(
     # Tenant-prefixed patterns report per-tenant splits (the isolation
     # scenario the sharded mesh's namespace layer serves).
     track_tenants = key_pattern == "noisy-neighbor"
+    ledger = None
+    if key_pattern == "crash-restart":
+        ledger = crash_restart_ledger(key_space)
+        result.ledger_burst = burst
 
     def tally(allowed, key=None) -> None:
         t_s = time.perf_counter() - t_start
@@ -637,6 +680,8 @@ async def run_perf_test(
             result.denied += 1
         if track_tenants and key is not None:
             result.track_tenant(key, allowed)
+        if ledger is not None and key is not None and key in ledger:
+            result.track_ledger(key, allowed)
         if chaos:
             result.track_outcome(allowed is None, t_s)
 
@@ -774,7 +819,8 @@ def main(argv=None) -> int:
                    choices=["sequential", "random", "zipfian",
                             "user-resource", "hotkey-abuse",
                             "flash-crowd", "chaos", "noisy-neighbor",
-                            "diurnal", "slow-drift", "rolling-restart"])
+                            "diurnal", "slow-drift", "rolling-restart",
+                            "crash-restart"])
     p.add_argument("--stats", action="store_true",
                    help="poll GET /stats (the insight tier) every "
                         "200 ms during the run and report hot-key "
@@ -882,6 +928,8 @@ def main(argv=None) -> int:
             summary["procs"] = args.procs
         if args.chaos:
             summary["chaos"] = result.chaos_summary()
+        if key_pattern == "crash-restart":
+            summary["warm_start"] = result.warm_start_summary()
         if result.stats_probe is not None:
             summary["stats"] = result.stats_probe.summary()
         if result.tenant_counts:
@@ -902,7 +950,7 @@ def _proc_entry(transport, host, port, workers, requests, kwargs):
         result.denied, result.errors, result.latencies_s,
         result.max_consecutive_errors, result.first_error_s,
         result.last_recovery_s, result.deadline_misses,
-        result.max_stall_s,
+        result.max_stall_s, result.ledger_counts, result.ledger_burst,
     )
 
 
@@ -941,7 +989,8 @@ def run_multiproc(
         key_pattern=kwargs.get("key_pattern", "random"),
     )
     for (total, elapsed, allowed, denied, errors, lats,
-         max_consec, first_err, last_rec, dl_misses, max_stall) in parts:
+         max_consec, first_err, last_rec, dl_misses, max_stall,
+         ledger_counts, ledger_burst) in parts:
         merged.total_requests += total
         merged.elapsed_s = max(merged.elapsed_s, elapsed)
         merged.allowed += allowed
@@ -960,6 +1009,10 @@ def run_multiproc(
         # Per-process stalls only (cross-process response interleaving
         # is unobservable here); the max is still the fleet's worst.
         merged.max_stall_s = max(merged.max_stall_s, max_stall)
+        # Ledger keys are shared across processes: per-key allows sum.
+        merged.ledger_burst = ledger_burst or merged.ledger_burst
+        for k, c in ledger_counts.items():
+            merged.ledger_counts[k] = merged.ledger_counts.get(k, 0) + c
     return merged
 
 

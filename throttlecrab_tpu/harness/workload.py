@@ -191,9 +191,33 @@ def make_keys(
         warm = rng.integers(n_hot, max(key_space, n_hot + 1), n_requests)
         is_hot = rng.random(n_requests) < 0.3
         ids = np.where(is_hot, hot, warm)
+    elif pattern == "crash-restart":
+        # Companion for the crash-recovery soak (SIGKILL -> restart on
+        # the same checkpoint dir): a FIXED population with a small
+        # ledger band (crash_restart_ledger) driven far past its limit
+        # — the load generator audits cumulative allows per ledger key,
+        # so a restart that comes back cold (forgot checkpointed state)
+        # surfaces as per-key allows beyond one burst, while the
+        # over-allow-only restore means a wrong deny can never hide in
+        # the noise.  A uniform warm tail keeps the table — and every
+        # checkpoint delta — realistically populated.
+        n_hot = max(key_space // 200, 1)
+        hot = rng.integers(0, n_hot, n_requests)
+        warm = rng.integers(n_hot, max(key_space, n_hot + 1), n_requests)
+        is_hot = rng.random(n_requests) < 0.5
+        ids = np.where(is_hot, hot, warm)
     else:
         raise ValueError(f"unknown key pattern: {pattern!r}")
     return [f"key:{i}" for i in ids]
+
+
+def crash_restart_ledger(key_space: int):
+    """The crash-restart pattern's ledger band: the fixed hot keys
+    whose cumulative allows the load generator audits for warm-restart
+    evidence (allows past one burst per key = state the restart
+    forgot)."""
+    n_hot = max(key_space // 200, 1)
+    return {f"key:{i}" for i in range(n_hot)}
 
 
 def flash_crowd_hot_sets(key_space: int):

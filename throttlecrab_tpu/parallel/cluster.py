@@ -736,6 +736,9 @@ class ClusterLimiter(ScalarCompatMixin):
         self._leave_complete = threading.Event()
         # Diagnostics (peer_stats / cluster_view / metrics).
         self.migrated_in = 0
+        #: Inbound migrate rows dropped because the local row (e.g. a
+        #: crash-rejoin's checkpoint restore) was at least as new.
+        self.reconciled_stale = 0
         self.takeover_count = 0
         self.replica_drops = 0
         self.handoff_timeouts = 0
@@ -797,6 +800,7 @@ class ClusterLimiter(ScalarCompatMixin):
             "replica_drops": self.replica_drops,
             "takeovers": self.takeover_count,
             "migrated_in": self.migrated_in,
+            "reconciled_stale": self.reconciled_stale,
             "handoff_timeouts": self.handoff_timeouts,
             "leaves": self.leave_count,
             "lame_duck": lame_duck,
@@ -1526,18 +1530,53 @@ class ClusterLimiter(ScalarCompatMixin):
         return self.ring_state()
 
     def apply_migrate(self, origin: int, epoch: int, keys, tats, exps):
-        """Install inbound OP_MIGRATE rows and clear the handoff gate."""
+        """Install inbound OP_MIGRATE rows and clear the handoff gate.
+
+        Crash-rejoin reconcile: a node that restored a local checkpoint
+        before announcing has a non-empty table when the successor's
+        migrate-back lands.  Per key the *newest* row wins — the
+        inbound row overwrites (bulk insert semantics) unless the local
+        row's TAT is at least as new (tie broken by expiry).  Dropping
+        the older row is over-allow-only by the GCRA clamp argument
+        either way."""
         from ..faults import maybe_fail
-        from ..tpu.snapshot import _bulk_insert
+        from ..tpu.snapshot import _bulk_insert, export_state
 
         maybe_fail("migrate")
         n = len(keys)
+        stale = 0
         if n and self.ring is not None:
             try:
+                decoded = self._decode_wire_keys(keys)
+                tats = [int(t) for t in tats]
+                exps = [int(e) for e in exps]
                 with self.device_lock:
-                    _bulk_insert(
-                        self.local, self._decode_wire_keys(keys), tats,
-                        exps,
+                    if len(self.local) != 0:
+                        k_col, _s, _sh, t_col, e_col, _c, _d = (
+                            export_state(self.local)
+                        )
+                        local_rows = {
+                            k: (int(t_col[i]), int(e_col[i]))
+                            for i, k in enumerate(k_col)
+                        }
+                        keep = [
+                            i
+                            for i, k in enumerate(decoded)
+                            if local_rows.get(k, (-1, -1))
+                            < (tats[i], exps[i])
+                        ]
+                        stale = n - len(keep)
+                        if stale:
+                            decoded = [decoded[i] for i in keep]
+                            tats = [tats[i] for i in keep]
+                            exps = [exps[i] for i in keep]
+                    if decoded:
+                        _bulk_insert(self.local, decoded, tats, exps)
+                if stale:
+                    self.reconciled_stale += stale
+                    log.info(
+                        "reconciled %d stale inbound row(s) against "
+                        "newer local state (crash-rejoin)", stale,
                     )
             except Exception:
                 # A refused insert (e.g. table full) must not leave the

@@ -58,6 +58,7 @@ def build_transports(config: Config, engine, metrics):
                     front=engine.front,
                     insight=engine.insight,
                     control=engine.control,
+                    checkpointer=engine.checkpointer,
                 )
             )
         else:
@@ -98,6 +99,7 @@ def build_transports(config: Config, engine, metrics):
                     front=engine.front,
                     insight=engine.insight,
                     control=engine.control,
+                    checkpointer=engine.checkpointer,
                 )
             )
         else:
@@ -169,6 +171,51 @@ def restore_snapshot_on_boot(limiter, config: Config) -> int:
     except Exception:
         log.exception("post-restore-failure sweep failed")
     return 0
+
+
+def restore_on_boot(limiter, config: Config, checkpointer) -> int:
+    """Boot restore precedence: checkpoint chain first, snapshot second.
+
+    The checkpoint directory is best-effort durable state, so its
+    recovery never refuses boot (torn/corrupt generations narrow what
+    gets restored — persist/recovery.py).  Only when no usable chain
+    exists does boot fall through to the explicitly-named snapshot,
+    which keeps its THROTTLECRAB_SNAPSHOT_STRICT refuse-on-corrupt
+    policy."""
+    import time as _time
+
+    if checkpointer is not None:
+        from ..persist import recover_into
+
+        try:
+            res = recover_into(
+                limiter, checkpointer.directory, _time.time_ns()
+            )
+        except Exception:
+            # Non-corruption failure (e.g. capacity): same soft policy
+            # as the snapshot path — sweep to a real cold start and
+            # fall through.
+            log.exception(
+                "checkpoint recovery failed; falling back to "
+                "snapshot restore (%s)", checkpointer.directory,
+            )
+            try:
+                limiter.sweep(1 << 62)
+            except Exception:
+                log.exception("post-recovery-failure sweep failed")
+            res = None
+        if res is not None:
+            checkpointer.note_recovery(
+                res.restored, res.corrupt_skipped, res.chains
+            )
+            log.info(
+                "recovered %d keys from checkpoint chain gen=%d "
+                "(%d corrupt generation(s) skipped, manifest=%s)",
+                res.restored, res.generation, res.corrupt_skipped,
+                "used" if res.used_manifest else "rebuilt",
+            )
+            return res.restored
+    return restore_snapshot_on_boot(limiter, config)
 
 
 async def run_server(config: Config) -> None:
@@ -246,12 +293,33 @@ async def run_server(config: Config) -> None:
             supervisor.on_repromote = (
                 lambda: cluster.schedule_reweight(1.0)
             )
+    checkpointer = None
+    if config.checkpoint_dir:
+        # Crash durability (persist/): background generation-chain
+        # checkpoints plus boot-time recovery.  With interval 0 the
+        # subsystem is recovery + shutdown-flush only (no ticks, no
+        # dirty tracking).
+        from ..persist import Checkpointer
+
+        checkpointer = Checkpointer(
+            limiter,
+            config.checkpoint_dir,
+            interval_ns=config.checkpoint_interval_ms * 1_000_000,
+            retain=config.checkpoint_retain,
+            mode=config.checkpoint_mode,
+        )
+        metrics.set_checkpoint_stats_provider(checkpointer.metric_stats)
+        log.info(
+            "checkpointing armed: dir=%s interval=%dms retain=%d mode=%s",
+            config.checkpoint_dir, config.checkpoint_interval_ms,
+            config.checkpoint_retain, config.checkpoint_mode,
+        )
     loop = asyncio.get_running_loop()
     # The restore is a device bulk-insert (and, on a corrupt snapshot,
     # a full sweep): executor, not the event loop — by the time the
     # cluster RPC listener starts serving below, the loop must be free.
     await loop.run_in_executor(
-        None, restore_snapshot_on_boot, limiter, config
+        None, restore_on_boot, limiter, config, checkpointer
     )
     # Front tier (L3.5): exact deny cache + admission control, shared
     # by the asyncio engine and the native transports.  Built after the
@@ -296,6 +364,7 @@ async def run_server(config: Config) -> None:
         insight=insight,
         control=control,
         deadline_default_ms=config.deadline_default_ms,
+        checkpointer=checkpointer,
     )
     transports = build_transports(config, engine, metrics)
     if cluster_nodes:
@@ -422,19 +491,32 @@ async def run_server(config: Config) -> None:
         limiter.close()
     for transport in transports:
         await transport.stop()
+    if checkpointer is not None:
+        # Final generation flush: transports are stopped, so the bare
+        # (lockless) export races nothing.  Best-effort — a failed
+        # flush leaves the previous durable chain intact.
+        await loop.run_in_executor(None, checkpointer.stop)
     if config.snapshot_path:
-        from ..tpu.snapshot import save_snapshot
+        from ..tpu.snapshot import (
+            export_snapshot_payload,
+            write_snapshot_payload,
+        )
 
-        def locked_save() -> int:
+        def locked_export() -> dict:
             # The lock serializes against any straggling native driver
-            # thread; transports are already stopped, so holding it
-            # across the file write is shutdown-only by construction.
+            # thread, but only the device export rides the hold — the
+            # .npz compression and file/fsync work below run with it
+            # released.
             with engine.limiter_lock:
-                return save_snapshot(limiter, config.snapshot_path)  # inv: allow(block-under-lock)
+                return export_snapshot_payload(limiter)
 
         try:
             # Device export + .npz write: executor, not the event loop.
-            saved = await loop.run_in_executor(None, locked_save)
+            payload = await loop.run_in_executor(None, locked_export)
+            saved = await loop.run_in_executor(
+                None, write_snapshot_payload, payload,
+                config.snapshot_path,
+            )
             log.info(
                 "saved %d keys to snapshot %s",
                 saved, config.snapshot_path,

@@ -119,6 +119,27 @@ _SPEC = [
      "Refuse to start when the boot snapshot is corrupt/truncated "
      "(env 0 disables: log the corruption and start with an empty "
      "table instead)"),
+    # --- crash durability (throttlecrab_tpu/persist/) ------------------
+    ("checkpoint_interval_ms", "THROTTLECRAB_CHECKPOINT_INTERVAL_MS",
+     0, int,
+     "Milliseconds between background checkpoint generations (0 — the "
+     "default — disables checkpointing entirely; needs "
+     "--checkpoint-dir)"),
+    ("checkpoint_dir", "THROTTLECRAB_CHECKPOINT_DIR", "", str,
+     "Directory for generation-numbered, CRC-checksummed checkpoint "
+     "chains (full base + incremental deltas).  At boot the newest "
+     "verifiable chain is restored, falling back generation-by-"
+     "generation past torn/corrupt files — never refusing to start "
+     "(contrast THROTTLECRAB_SNAPSHOT_STRICT, which keeps its meaning "
+     "for an explicitly-named boot snapshot)"),
+    ("checkpoint_retain", "THROTTLECRAB_CHECKPOINT_RETAIN", 2, int,
+     "Generation chains kept on disk (a new full base starts a chain "
+     "and prunes the oldest beyond this bound; >= 1)"),
+    ("checkpoint_mode", "THROTTLECRAB_CHECKPOINT_MODE", "incremental",
+     str,
+     "incremental (full base then deltas of slots dirtied since the "
+     "previous generation, re-based periodically) or full (every "
+     "generation is a complete base)"),
     # --- failure-domain supervision (server/supervisor.py, faults/) ----
     ("supervisor_retries", "THROTTLECRAB_SUPERVISOR_RETRIES", 3, int,
      "Max retries of a transient (UNAVAILABLE-shaped) device "
@@ -139,7 +160,8 @@ _SPEC = [
     ("faults", "THROTTLECRAB_FAULTS", "", str,
      "Fault injection spec site:mode[:arg],... — sites launch, fetch, "
      "peer, keymap, snapshot, migrate; modes transient:p, persistent, "
-     "count:n, hang:seconds (empty: off; see throttlecrab_tpu/faults/)"),
+     "count:n, hang:seconds, truncate:frac, fsyncfail (empty: off; "
+     "see throttlecrab_tpu/faults/)"),
     ("faults_seed", "THROTTLECRAB_FAULTS_SEED", 0, int,
      "Seed for the deterministic fault-injection probability stream"),
     # --- record/replay flight recorder (throttlecrab_tpu/replay/) ------
@@ -309,6 +331,10 @@ class Config:
     front_peek_frac: float = 0.9
     snapshot_path: str = ""
     snapshot_strict: bool = True
+    checkpoint_interval_ms: int = 0
+    checkpoint_dir: str = ""
+    checkpoint_retain: int = 2
+    checkpoint_mode: str = "incremental"
     supervisor_retries: int = 3
     supervisor_backoff_us: int = 2000
     supervisor_backoff_max_us: int = 50_000
@@ -432,6 +458,19 @@ class Config:
             raise ConfigError("front admission bounds must be >= 0")
         if not 0.0 < self.front_peek_frac <= 1.0:
             raise ConfigError("front_peek_frac must be in (0, 1]")
+        if self.checkpoint_interval_ms < 0:
+            raise ConfigError("checkpoint_interval_ms must be >= 0")
+        if self.checkpoint_interval_ms > 0 and not self.checkpoint_dir:
+            raise ConfigError(
+                "checkpoint_interval_ms needs --checkpoint-dir"
+            )
+        if self.checkpoint_retain < 1:
+            raise ConfigError("checkpoint_retain must be >= 1")
+        if self.checkpoint_mode not in ("incremental", "full"):
+            raise ConfigError(
+                f"Invalid checkpoint mode: {self.checkpoint_mode!r} "
+                "(expected incremental or full)"
+            )
         if self.supervisor_mode not in ("degrade", "fail"):
             raise ConfigError(
                 f"Invalid supervisor mode: {self.supervisor_mode!r} "

@@ -90,6 +90,7 @@ class BatchingEngine:
         insight=None,
         control=None,
         deadline_default_ms: int = 0,
+        checkpointer=None,
     ) -> None:
         """`limiter` is a TpuRateLimiter / ShardedTpuRateLimiter (or any
         object with rate_limit_batch + sweep).  `now_fn` injects time for
@@ -116,6 +117,11 @@ class BatchingEngine:
         self.front = front
         self.insight = insight
         self.control = control
+        #: Optional persist.Checkpointer: decided windows mark their
+        #: keys dirty (host-side set insert — the device hot loop is
+        #: untouched) and the housekeeping path drives its throttled
+        #: tick, same discipline as insight/control.
+        self.checkpointer = checkpointer
         # Serializes device access with native transports that drive the
         # same limiter from their own threads (server/native_redis.py).
         self.limiter_lock = threading.Lock()
@@ -505,6 +511,14 @@ class BatchingEngine:
             finally:
                 front.end_inflight(r.key)
 
+    def _note_dirty(self, windows) -> None:
+        """Mark every decided key dirty for the next checkpoint delta
+        (host-side set insert; rides the same post-decision path as the
+        front-tier observe so the device hot loop is untouched)."""
+        ck = self.checkpointer
+        if ck is not None:
+            ck.note_keys(r.key for w in windows for r, _ in w)
+
     async def _fetch_complete(self, in_flight) -> None:
         """Fetch an in-flight launch's results and resolve its futures."""
         windows, handle, now_ns, seq = in_flight
@@ -526,6 +540,7 @@ class BatchingEngine:
                 # Admission-only fronts skip the per-row observe loop:
                 # every call inside it would be a no-op.
                 self._observe_window(window, result, now_ns, seq)
+        self._note_dirty(windows)
         await self._maybe_record(windows, results, now_ns)
         if self.front is not None:
             self.front.record_launch(total, elapsed)
@@ -579,6 +594,7 @@ class BatchingEngine:
                 # Admission-only fronts skip the per-row observe loop:
                 # every call inside it would be a no-op.
                 self._observe_window(window, result, now_ns, seq)
+        self._note_dirty(windows)
         await self._maybe_record(windows, results, now_ns)
         if self.front is not None:
             self.front.record_launch(total, elapsed)
@@ -631,6 +647,7 @@ class BatchingEngine:
         self._complete(batch, result)
         if self.front is not None and self.front.deny_cache is not None:
             self._observe_window(batch, result, now_ns, seq)
+        self._note_dirty([batch])
         await self._maybe_record([batch], [result], now_ns)
         await self._maybe_sweep(now_ns, len(batch))
 
@@ -727,6 +744,16 @@ class BatchingEngine:
                 lambda: control.maybe_tick(
                     now_ns, self.limiter_lock, queue_depth=depth
                 ),
+            )
+        checkpointer = self.checkpointer
+        if checkpointer is not None and checkpointer.tick_due(now_ns):
+            # Throttled checkpoint write (persist/): the device export
+            # happens under the limiter lock (a "device"-kind hold, like
+            # the insight poll); encode + CRC + fsync run outside it,
+            # all off the event loop.
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, checkpointer.maybe_tick, now_ns, self.limiter_lock
             )
         policy = self.cleanup_policy
         if policy is None:

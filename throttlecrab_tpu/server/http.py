@@ -153,6 +153,12 @@ class HttpTransport(ConnTrackingMixin):
             # the traffic degraded mode exists to keep answering.
             state = self.engine.health_state()
             body = b"OK" if state == "ok" else state.encode()
+            ck = getattr(self.engine, "checkpointer", None)
+            if ck is not None:
+                # Last-checkpoint age rides /health only when the
+                # durability subsystem is armed — the bare "OK" body is
+                # a wire contract (reference-compatible) otherwise.
+                body += b" " + ck.health_suffix().encode()
             return 200, body, "text/plain"
         if method == "GET" and path == "/health/cluster":
             # The cluster view (ring deployments): membership epoch,

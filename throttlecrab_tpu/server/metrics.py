@@ -86,6 +86,13 @@ METRIC_NAMES = (
     "throttlecrab_tpu_control_clamped",
     "throttlecrab_tpu_control_objective",
     "throttlecrab_tpu_control_shed_rate",
+    # Crash durability (persist/): checkpoint chain + boot recovery.
+    "throttlecrab_tpu_checkpoint_generation",
+    "throttlecrab_tpu_checkpoint_age_seconds",
+    "throttlecrab_tpu_checkpoint_duration_seconds",
+    "throttlecrab_tpu_checkpoint_bytes",
+    "throttlecrab_tpu_checkpoint_corrupt_skipped_total",
+    "throttlecrab_tpu_checkpoint_recoveries_total",
 )
 
 
@@ -174,6 +181,8 @@ class Metrics:
         self._insight_stats = None
         # Control plane (L3.9).
         self._control_stats = None
+        # Crash durability (persist/).
+        self._checkpoint_stats = None
         # Tenant/namespace layer (sharded mesh).
         self._tenant_stats = None
 
@@ -324,6 +333,12 @@ class Metrics:
         """`provider()` -> ControlPlane.metric_stats(); exported as the
         throttlecrab_tpu_control_* gauges (zeros when absent)."""
         self._control_stats = provider
+
+    def set_checkpoint_stats_provider(self, provider) -> None:
+        """`provider()` -> Checkpointer.metric_stats(); exported as the
+        throttlecrab_tpu_checkpoint_* gauges (absent when
+        checkpointing is disarmed)."""
+        self._checkpoint_stats = provider
 
     def set_cluster_stats_provider(self, provider) -> None:
         """`provider()` -> {peer_addr: {"forwarded": n, "failed": n,
@@ -618,6 +633,47 @@ class Metrics:
             "Shed fraction of arrivals over the last control tick",
             "gauge",
             ctl.get("shed_rate", 0),
+        )
+        # Crash durability (persist/): zeros/-1 when disarmed.
+        ck = self._checkpoint_stats() if self._checkpoint_stats else {}
+        metric(
+            "throttlecrab_tpu_checkpoint_generation",
+            "Newest durable checkpoint generation (-1: none yet)",
+            "gauge",
+            ck.get("generation", -1),
+        )
+        metric(
+            "throttlecrab_tpu_checkpoint_age_seconds",
+            "Seconds since the last durable checkpoint "
+            "(-1: none yet / disarmed)",
+            "gauge",
+            ck.get("age_seconds", -1),
+        )
+        metric(
+            "throttlecrab_tpu_checkpoint_duration_seconds",
+            "Wall time of the last checkpoint write "
+            "(encode + CRC + fsync, outside the limiter lock)",
+            "gauge",
+            ck.get("duration_seconds", 0),
+        )
+        metric(
+            "throttlecrab_tpu_checkpoint_bytes",
+            "Size of the last checkpoint generation on disk",
+            "gauge",
+            ck.get("bytes", 0),
+        )
+        metric(
+            "throttlecrab_tpu_checkpoint_corrupt_skipped_total",
+            "Torn/corrupt generations dropped by boot recovery's "
+            "generation-by-generation fallback",
+            "counter",
+            ck.get("corrupt_skipped_total", 0),
+        )
+        metric(
+            "throttlecrab_tpu_checkpoint_recoveries_total",
+            "Boot-time recoveries that restored a checkpoint chain",
+            "counter",
+            ck.get("recoveries_total", 0),
         )
         # Tenant/namespace layer (sharded mesh deployments only).
         tenant_provider = getattr(self, "_tenant_stats", None)

@@ -62,6 +62,7 @@ class NativeRedisTransport:
         front=None,
         insight=None,
         control=None,
+        checkpointer=None,
     ) -> None:
         lib = get_wire_lib()
         if lib is None:
@@ -80,6 +81,10 @@ class NativeRedisTransport:
         # throttled control tick, right after the insight poll (None —
         # the default — means no sensor read and no knob ever moves).
         self.control = control
+        # Crash durability (persist/): decided keys mark dirty and this
+        # driver thread drives the throttled checkpoint tick, same
+        # discipline as insight/control.
+        self.checkpointer = checkpointer
         # Front tier (L3.5): shared with the asyncio engine, so a deny
         # cached on one transport serves (and is invalidated by) all of
         # them.  The lookup runs in this driver BEFORE batch prep —
@@ -645,6 +650,20 @@ class NativeRedisTransport:
             # admission's EWMA wait still carries the launch-cost
             # signal.
             self.control.maybe_tick(now_ns, self.limiter_lock)
+        if self.checkpointer is not None:
+            if frames:
+                # Launched rows mark dirty for the next delta (raw wire
+                # key bytes — the identity the keymap holds on this
+                # path, so the delta gather matches the export).
+                self.checkpointer.note_keys(
+                    k
+                    for b, o, _p in frames
+                    for k in self._keys_of(b, o)
+                )
+            # Throttled checkpoint write: device export under
+            # limiter_lock, encode + fsync outside it — this driver
+            # thread blocks on the device for its decides anyway.
+            self.checkpointer.maybe_tick(now_ns, self.limiter_lock)
         if self.metrics is not None and (
             any_launch or tot_errors
         ):
@@ -753,6 +772,11 @@ class NativeRedisTransport:
         else:
             state = supervisor_state(self.limiter)
         body = b"OK" if state == "ok" else state.encode()
+        if self.checkpointer is not None:
+            # Last-checkpoint age rides /health only when durability is
+            # armed (the bare "OK" body is a wire contract otherwise) —
+            # same rule as the python HTTP route.
+            body += b" " + self.checkpointer.health_suffix().encode()
         self._lib.ws_set_health(self._h, body, len(body))
         if self.insight is not None:
             from .metrics import merge_cluster_stats

@@ -21,7 +21,7 @@ from typing import Union
 
 import numpy as np
 
-from ..faults import maybe_fail
+from ..faults import fsync_with_faults, maybe_fail
 
 FORMAT_VERSION = 2  # v2 adds the per-key `shard` column (v1 loads fine)
 
@@ -132,6 +132,55 @@ def export_state(limiter):
     return keys, slots, shard, tat, expiry, limiter.table.capacity, n_shards
 
 
+def translate_key(
+    raw: bytes,
+    is_bytes: bool,
+    codec: int,
+    source_bytes_keys: bool,
+    target_bytes_keys: bool,
+):
+    """Cross-backend key identity translation for restores.
+
+    str-keyed transports look keys up as str, bytes-keyed (native)
+    keymaps as bytes.  A snapshot from a native keymap marks everything
+    bytes even though the transports used str — restoring it into a
+    python keymap must decode back to str (surrogateescape: lossless
+    for arbitrary bytes) or the restored buckets would be silently
+    unreachable.  Shared by :func:`load_snapshot` and the checkpoint
+    recovery scanner (persist/recovery.py), which must agree exactly.
+    """
+    if target_bytes_keys:
+        return raw  # native keymaps hold bytes; str lookups encode
+    if source_bytes_keys and is_bytes:
+        return raw.decode("utf-8", "surrogateescape")
+    if is_bytes:
+        return raw  # genuinely-bytes key in a str keymap: keep as-is
+    return raw.decode(
+        "utf-8", "surrogatepass" if codec else "surrogateescape"
+    )
+
+
+def fsync_dir(path: Union[str, Path]) -> None:
+    """fsync a directory so a just-renamed entry survives power loss.
+
+    Best-effort: platforms/filesystems that refuse to open or fsync a
+    directory degrade to the pre-fsync durability story rather than
+    failing the save.
+    """
+    import os
+
+    try:
+        fd = os.open(str(path), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _normalize(path: Union[str, Path]) -> Path:
     """np.savez_compressed appends .npz to suffix-less paths; normalize
     BOTH save and load so `--snapshot-path /data/state` round-trips
@@ -143,27 +192,52 @@ def _normalize(path: Union[str, Path]) -> Path:
     return path
 
 
-def save_snapshot(limiter, path: Union[str, Path]) -> int:
-    """Write the limiter's live state to `path` (.npz); returns #keys saved.
+def export_snapshot_payload(limiter) -> dict:
+    """Device/host export half of :func:`save_snapshot`.
 
-    Works for TpuRateLimiter (single device), ShardedTpuRateLimiter
-    (per-shard columns in one npz), and ClusterLimiter (delegates to the
-    node's local limiter — each cluster node owns its key range, so a
-    cluster snapshot is one file per node, like one RDB per Redis shard).
-    Only live slots are saved: tat/expiry columns plus each slot's key
-    bytes.
+    Touches only the limiter (device fetch + keymap walk) — no
+    encoding, no compression, no file I/O — so it is the one part of a
+    snapshot that belongs *under* the limiter lock.  The returned
+    payload is self-contained: hand it to
+    :func:`write_snapshot_payload` outside the lock.
     """
     from .limiter import limiter_uses_bytes_keys
 
     local = getattr(limiter, "local", None)
     if local is not None:  # ClusterLimiter
-        return save_snapshot(local, path)
-
-    path = _normalize(path)
+        return export_snapshot_payload(local)
     raw_keys, slots, shard, tat, expiry, capacity, n_shards = (
         export_state(limiter)
     )
-    keys, key_is_bytes, key_codec = _encode_keys(raw_keys)
+    return {
+        "keys": raw_keys,
+        "slots": slots,
+        "shard": shard,
+        "tat": tat,
+        "expiry": expiry,
+        "capacity": capacity,
+        "n_shards": n_shards,
+        # The source keymap's key mode: a bytes-keyed (native) keymap
+        # stores every key as bytes even when the transports spoke str —
+        # the restore side needs this to translate identities correctly.
+        "source_bytes_keys": limiter_uses_bytes_keys(limiter),
+    }
+
+
+def write_snapshot_payload(payload: dict, path: Union[str, Path]) -> int:
+    """Encode + compress + durably write an exported payload to `path`.
+
+    The slow half of :func:`save_snapshot`: npz compression and file
+    I/O with no limiter access at all — call it with every limiter
+    lock released.  Durable, not just atomic: the tmp file is fsynced
+    before the rename and the parent directory after it, so a crash
+    shortly after a "successful" save cannot surface an empty or torn
+    file on ext4/xfs.
+    """
+    import os
+
+    path = _normalize(path)
+    keys, key_is_bytes, key_codec = _encode_keys(payload["keys"])
 
     # Length-prefixed layout (offsets[n+1] + blob): binary-safe for keys
     # containing any byte, including NUL.
@@ -173,34 +247,64 @@ def save_snapshot(limiter, path: Union[str, Path]) -> int:
     key_blob = b"".join(keys)
     # Atomic write: a kill mid-save must never clobber the previous good
     # snapshot (np.savez_compressed writes the destination in place).
-    import os
-
     maybe_fail("snapshot")
     tmp = path.with_name(path.name + ".tmp")
+    try:
+        _write_npz_tmp(tmp, payload, offsets, key_blob, key_is_bytes,
+                       key_codec, len(keys))
+    except BaseException:
+        # A failed (or unsynced) write must leave neither a torn final
+        # file nor a stray tmp — the previous good snapshot stands.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+    fsync_dir(path.parent)
+    return len(keys)
+
+
+def _write_npz_tmp(
+    tmp, payload, offsets, key_blob, key_is_bytes, key_codec, n_keys
+) -> None:
     with open(tmp, "wb") as f:
         np.savez_compressed(
             f,
             version=np.int64(FORMAT_VERSION),
-            capacity=np.int64(capacity),
-            slots=slots,
-            shard=shard,
-            n_shards=np.int64(n_shards),
-            tat=tat,
-            expiry=expiry,
+            capacity=np.int64(payload["capacity"]),
+            slots=payload["slots"],
+            shard=payload["shard"],
+            n_shards=np.int64(payload["n_shards"]),
+            tat=payload["tat"],
+            expiry=payload["expiry"],
             key_offsets=offsets,
             key_blob=np.frombuffer(key_blob, np.uint8),
             key_is_bytes=np.asarray(key_is_bytes, np.uint8),
             key_codec=np.asarray(key_codec, np.uint8),
-            # The source keymap's key mode: a bytes-keyed (native) keymap
-            # stores every key as bytes even when the transports spoke str —
-            # the restore side needs this to translate identities correctly.
-            source_bytes_keys=np.uint8(limiter_uses_bytes_keys(limiter)),
+            source_bytes_keys=np.uint8(payload["source_bytes_keys"]),
             meta=np.frombuffer(
-                json.dumps({"n_keys": len(keys)}).encode(), np.uint8
+                json.dumps({"n_keys": n_keys}).encode(), np.uint8
             ),
         )
-    os.replace(tmp, path)
-    return len(keys)
+        f.flush()
+        fsync_with_faults("snapshot", f.fileno())
+
+
+def save_snapshot(limiter, path: Union[str, Path]) -> int:
+    """Write the limiter's live state to `path` (.npz); returns #keys saved.
+
+    Works for TpuRateLimiter (single device), ShardedTpuRateLimiter
+    (per-shard columns in one npz), and ClusterLimiter (delegates to the
+    node's local limiter — each cluster node owns its key range, so a
+    cluster snapshot is one file per node, like one RDB per Redis shard).
+    Only live slots are saved: tat/expiry columns plus each slot's key
+    bytes.  Composes :func:`export_snapshot_payload` (device half) and
+    :func:`write_snapshot_payload` (I/O half); callers holding the
+    limiter lock should run the two halves separately so compression
+    and fsync happen outside it.
+    """
+    return write_snapshot_payload(export_snapshot_payload(limiter), path)
 
 
 def load_snapshot(
@@ -295,12 +399,7 @@ def load_snapshot(
     ):
         raise SnapshotError("corrupt snapshot: key offsets inconsistent")
 
-    # Cross-backend identity translation: str-keyed transports look keys
-    # up as str, bytes-keyed (native) keymaps as bytes.  A snapshot from a
-    # native keymap marks everything bytes even though the transports used
-    # str — restoring it into a python keymap must decode back to str
-    # (surrogateescape: lossless for arbitrary bytes) or the restored
-    # buckets would be silently unreachable.
+    # Cross-backend identity translation: see translate_key.
     target_bytes_keys = limiter_uses_bytes_keys(limiter)
     live = expiry > now_ns
     restored = 0
@@ -311,15 +410,13 @@ def load_snapshot(
         if not live[i]:
             continue
         raw = key_blob[offsets[i] : offsets[i + 1]]
-        codec = "surrogatepass" if key_codec[i] else "surrogateescape"
-        if target_bytes_keys:
-            key = raw  # native keymaps hold bytes; str lookups encode
-        elif source_bytes_keys and key_is_bytes[i]:
-            key = raw.decode("utf-8", "surrogateescape")
-        elif key_is_bytes[i]:
-            key = raw  # genuinely-bytes key in a str keymap: keep as-is
-        else:
-            key = raw.decode("utf-8", codec)
+        key = translate_key(
+            raw,
+            bool(key_is_bytes[i]),
+            int(key_codec[i]),
+            source_bytes_keys,
+            target_bytes_keys,
+        )
         batch_keys.append(key)
         batch_tat.append(int(tat[i]))
         batch_exp.append(int(expiry[i]))
